@@ -1,0 +1,39 @@
+"""Consolidation: sum diffs of identical (row, time) updates, drop zeros.
+
+The fundamental normal form of differential collections (reference:
+differential's `consolidate`, used pervasively; e.g. union consolidation at
+compute/src/render.rs:1336+). On TPU: lex-sort by full-row lanes, segmented
+sum of diffs, keep only segment leaders with nonzero accumulated diff,
+compact to a prefix.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..repr.batch import Batch
+from .lanes import row_lanes
+from .sort import apply_perm, compact, segment_ids, segment_starts, sort_perm
+
+
+def consolidate(batch: Batch, include_time: bool = True) -> Batch:
+    """Return an equivalent batch in consolidated normal form."""
+    cap = batch.capacity
+    lanes = row_lanes(batch, include_time=include_time)
+    perm = sort_perm(lanes, batch.count, cap)
+    sorted_batch = apply_perm(batch, perm)
+    # Permute the already-computed lanes instead of re-encoding every column.
+    lanes = [l[perm] for l in lanes]
+    starts = segment_starts(lanes, sorted_batch.count, cap)
+    seg = segment_ids(starts)
+    valid = sorted_batch.valid_mask()
+    diffs = jnp.where(valid, sorted_batch.diff, 0)
+    # Sum diffs within each segment; scatter-add into per-segment slots.
+    seg_sums = jnp.zeros(cap, dtype=diffs.dtype).at[seg].add(
+        diffs, mode="drop"
+    )
+    row_total = seg_sums[seg]
+    keep = jnp.logical_and(starts, row_total != 0)
+    out = sorted_batch.replace(diff=jnp.where(starts, row_total, 0))
+    return compact(out, keep)

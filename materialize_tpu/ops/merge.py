@@ -1,0 +1,65 @@
+"""Merge of two lexicographically sorted batches (merge-path scatter).
+
+The device analog of a differential spine merge (reference: differential
+spine maintenance behind MzArrange, compute/src/extensions/arrange.rs;
+merge effort governed by arrangement_exert_proportionality,
+cluster-client/src/client.rs:26-34). O((n+m) log) via two vectorized
+binary searches instead of a full re-sort.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..repr.batch import Batch
+from .search import lex_searchsorted
+
+
+def merge_sorted(
+    a: Batch,
+    a_lanes,
+    b: Batch,
+    b_lanes,
+    out_capacity: int,
+) -> tuple[Batch, jnp.ndarray]:
+    """Merge sorted `a` and `b` (same schema, each sorted by its lanes)
+    into one sorted batch of capacity `out_capacity`. Stable: ties keep
+    `a` rows first. Does NOT consolidate.
+
+    Returns (batch, overflowed): if a.count + b.count > out_capacity the
+    tail is dropped, count is clamped to out_capacity, and `overflowed`
+    is True — the host must retry at a larger capacity tier
+    (SURVEY.md §7 hard part #1)."""
+    assert a.schema.names == b.schema.names
+    cap_a, cap_b = a.capacity, b.capacity
+    ia = jnp.arange(cap_a, dtype=jnp.int32)
+    ib = jnp.arange(cap_b, dtype=jnp.int32)
+    # Position of a[i] = i + #{b rows strictly before it} (ties -> a first).
+    pos_a = ia + lex_searchsorted(b_lanes, b.count, a_lanes, side="left")
+    pos_b = ib + lex_searchsorted(a_lanes, a.count, b_lanes, side="right")
+    pos_a = jnp.where(ia < a.count, pos_a, out_capacity)  # drop padding
+    pos_b = jnp.where(ib < b.count, pos_b, out_capacity)
+
+    def scatter(field_a, field_b, dtype=None):
+        if field_a is None and field_b is None:
+            return None
+        if field_a is None:
+            field_a = jnp.zeros(cap_a, dtype=field_b.dtype)
+        if field_b is None:
+            field_b = jnp.zeros(cap_b, dtype=field_a.dtype)
+        out = jnp.zeros(out_capacity, dtype=field_a.dtype)
+        out = out.at[pos_a].set(field_a, mode="drop")
+        out = out.at[pos_b].set(field_b, mode="drop")
+        return out
+
+    total = (a.count + b.count).astype(jnp.int32)
+    overflowed = total > out_capacity
+    merged = Batch(
+        cols=tuple(scatter(ca, cb) for ca, cb in zip(a.cols, b.cols)),
+        nulls=tuple(scatter(na, nb) for na, nb in zip(a.nulls, b.nulls)),
+        time=scatter(a.time, b.time),
+        diff=scatter(a.diff, b.diff),
+        count=jnp.minimum(total, out_capacity),
+        schema=a.schema,
+    )
+    return merged, overflowed

@@ -1,0 +1,66 @@
+"""Vectorized lexicographic binary search over multi-lane sorted keys.
+
+The device analog of an arrangement cursor seek (differential trace cursors,
+used by mz_join_core at compute/src/render/join/mz_join_core.rs:574-600).
+Given `sorted_lanes` (tuple of [m] uint64 arrays, sorted lexicographically,
+first `count` valid) and `query_lanes` ([n] each), returns for each query
+row the left/right insertion point among the valid prefix — i.e. the match
+range for equal keys.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _lex_less(a_lanes, b_lanes):
+    """Elementwise a < b on lane tuples (lexicographic)."""
+    lt = jnp.zeros(a_lanes[0].shape, dtype=bool)
+    eq = jnp.ones(a_lanes[0].shape, dtype=bool)
+    for a, b in zip(a_lanes, b_lanes):
+        lt = jnp.logical_or(lt, jnp.logical_and(eq, a < b))
+        eq = jnp.logical_and(eq, a == b)
+    return lt
+
+
+def lex_eq(a_lanes, b_lanes):
+    eq = jnp.ones(a_lanes[0].shape, dtype=bool)
+    for a, b in zip(a_lanes, b_lanes):
+        eq = jnp.logical_and(eq, a == b)
+    return eq
+
+
+def lex_searchsorted(
+    sorted_lanes, count, query_lanes, side: str = "left"
+) -> jnp.ndarray:
+    """For each query tuple, the insertion index in the sorted valid prefix.
+
+    side='left' : first index i with sorted[i] >= q
+    side='right': first index i with sorted[i] >  q
+    Vectorized binary search: O(n log m), all rows step in lockstep.
+    """
+    m = sorted_lanes[0].shape[0]
+    n = query_lanes[0].shape[0]
+    lo = jnp.zeros(n, dtype=jnp.int32)
+    hi = jnp.broadcast_to(jnp.asarray(count, dtype=jnp.int32), (n,))
+    steps = max(1, m.bit_length())
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = (lo + hi) // 2
+        mid_lanes = tuple(l[mid] for l in sorted_lanes)
+        if side == "left":
+            go_right = _lex_less(mid_lanes, query_lanes)
+        else:
+            go_right = jnp.logical_not(_lex_less(query_lanes, mid_lanes))
+        # Only move when the range is non-empty.
+        nonempty = lo < hi
+        lo = jnp.where(jnp.logical_and(nonempty, go_right), mid + 1, lo)
+        hi = jnp.where(
+            jnp.logical_and(nonempty, jnp.logical_not(go_right)), mid, hi
+        )
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    return lo

@@ -1,0 +1,87 @@
+"""Sorting and compaction kernels.
+
+The workhorses of the update algebra on TPU: every consolidation, grouping,
+and arrangement build starts with a lexicographic sort on key lanes.
+XLA's variadic `lax.sort` sorts by the first `num_keys` operands
+lexicographically — the device analog of the reference's batcher sort
+(differential's `Batcher`, consumed via MzArrange,
+compute/src/extensions/arrange.rs).
+
+Invalid (padding) rows are kept at the tail by appending a validity lane
+that sorts valid rows first.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..repr.batch import Batch
+
+
+def sort_perm(lanes, count, capacity: int) -> jnp.ndarray:
+    """Permutation sorting valid rows lexicographically by `lanes`,
+    padding rows last. Stable."""
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    invalid = (idx >= count).astype(jnp.uint64)  # valid=0 sorts first
+    operands = [invalid] + [l for l in lanes] + [idx]
+    out = jax.lax.sort(operands, num_keys=len(operands) - 1, is_stable=True)
+    return out[-1]
+
+
+def apply_perm(batch: Batch, perm: jnp.ndarray) -> Batch:
+    take = lambda a: None if a is None else a[perm]
+    return Batch(
+        cols=tuple(take(c) for c in batch.cols),
+        nulls=tuple(take(n) for n in batch.nulls),
+        time=batch.time[perm],
+        diff=batch.diff[perm],
+        count=batch.count,
+        schema=batch.schema,
+    )
+
+
+def compact(batch: Batch, keep: jnp.ndarray) -> Batch:
+    """Drop rows where `keep` is False, moving survivors to a contiguous
+    prefix (stable). `keep` is anded with the validity mask.
+
+    Scatter-based: positions via exclusive cumsum, out-of-range drops.
+    """
+    keep = jnp.logical_and(keep, batch.valid_mask())
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    new_count = jnp.where(keep.shape[0] > 0, pos[-1] + 1, 0).astype(jnp.int32)
+    cap = batch.capacity
+    dest = jnp.where(keep, pos, cap)  # cap is out of range -> dropped
+
+    def scatter(a):
+        if a is None:
+            return None
+        out = jnp.zeros_like(a)
+        return out.at[dest].set(a, mode="drop")
+
+    return Batch(
+        cols=tuple(scatter(c) for c in batch.cols),
+        nulls=tuple(scatter(n) for n in batch.nulls),
+        time=scatter(batch.time),
+        diff=scatter(batch.diff),
+        count=new_count,
+        schema=batch.schema,
+    )
+
+
+def segment_starts(lanes, count, capacity: int) -> jnp.ndarray:
+    """Given rows already sorted by `lanes`, a bool mask marking the first
+    row of each run of equal keys (padding rows excluded)."""
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    valid = idx < count
+    first = idx == 0
+    differs = jnp.zeros(capacity, dtype=bool)
+    for lane in lanes:
+        prev = jnp.concatenate([lane[:1], lane[:-1]])
+        differs = jnp.logical_or(differs, lane != prev)
+    return jnp.logical_and(valid, jnp.logical_or(first, differs))
+
+
+def segment_ids(starts: jnp.ndarray) -> jnp.ndarray:
+    """0-based segment id per row from a segment-start mask."""
+    return jnp.cumsum(starts.astype(jnp.int32)) - 1

@@ -1,0 +1,165 @@
+"""Relation schemas and column types.
+
+Analog of the reference's ``RelationDesc`` / ``SqlScalarType``
+(``src/repr/src/relation.rs``) and ``Datum`` (``src/repr/src/scalar.rs:85``),
+re-cast columnar: a relation is a struct-of-arrays, each column a fixed-width
+device array. Variable-width data (strings) is dictionary-encoded host-side
+(int32 codes on device), matching SURVEY.md §7's design stance.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ColumnType(enum.Enum):
+    """Device-representable scalar types.
+
+    Subset of the reference's 30 Datum variants (src/repr/src/scalar.rs:85)
+    that covers the north-star workloads; exotic types (jsonb, ranges,
+    arbitrary-precision numeric) are deferred to host-side fallback.
+    """
+
+    BOOL = "bool"
+    INT32 = "int32"
+    INT64 = "int64"
+    FLOAT64 = "float64"
+    # Days since the UNIX epoch, like reference Datum::Date.
+    DATE = "date"
+    # Milliseconds since the UNIX epoch, like mz Timestamp (repr/src/timestamp.rs:46).
+    TIMESTAMP = "timestamp"
+    # Fixed-point decimal stored as a scaled int64 (reference uses dec i128;
+    # scale lives in the Column). Exact accumulation like Accum semigroup
+    # (compute/src/render/reduce.rs:1357).
+    DECIMAL = "decimal"
+    # Dictionary code (int32) into a host-side StringDictionary.
+    STRING = "string"
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(_DTYPES[self])
+
+    @property
+    def is_orderable_on_device(self) -> bool:
+        # Dictionary codes do not preserve lexicographic string order; they
+        # are valid for grouping/equality but not ORDER BY / MIN / MAX.
+        return self is not ColumnType.STRING
+
+
+_DTYPES = {
+    ColumnType.BOOL: np.bool_,
+    ColumnType.INT32: np.int32,
+    ColumnType.INT64: np.int64,
+    ColumnType.FLOAT64: np.float64,
+    ColumnType.DATE: np.int32,
+    ColumnType.TIMESTAMP: np.int64,
+    ColumnType.DECIMAL: np.int64,
+    ColumnType.STRING: np.int32,
+}
+
+# Timestamps of the virtual time axis (not SQL timestamps): u64 ms since epoch,
+# matching repr/src/timestamp.rs:46.
+TIME_DTYPE = np.uint64
+# Update multiplicities: i64, matching repr/src/diff.rs.
+DIFF_DTYPE = np.int64
+
+
+@dataclass(frozen=True)
+class Column:
+    name: str
+    ctype: ColumnType
+    nullable: bool = False
+    # Decimal scale: value = unscaled / 10**scale.
+    scale: int = 0
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self.ctype.dtype
+
+
+@dataclass(frozen=True)
+class Schema:
+    """Column layout of a collection (RelationDesc analog)."""
+
+    columns: tuple[Column, ...]
+
+    def __init__(self, columns):
+        object.__setattr__(self, "columns", tuple(columns))
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(c.name for c in self.columns)
+
+    @property
+    def arity(self) -> int:
+        return len(self.columns)
+
+    def __len__(self) -> int:
+        return len(self.columns)
+
+    def __getitem__(self, i: int) -> Column:
+        return self.columns[i]
+
+    def index_of(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(name)
+
+    def project(self, indices) -> "Schema":
+        return Schema([self.columns[i] for i in indices])
+
+    def concat(self, other: "Schema") -> "Schema":
+        return Schema(self.columns + other.columns)
+
+    def rename(self, names) -> "Schema":
+        assert len(names) == len(self.columns)
+        return Schema(
+            [
+                Column(n, c.ctype, c.nullable, c.scale)
+                for n, c in zip(names, self.columns)
+            ]
+        )
+
+
+class StringDictionary:
+    """Host-side string dictionary: str <-> int32 code.
+
+    Grows append-only; code order is insertion order, NOT lexicographic.
+    The reference stores strings inline in Row bytes (repr/src/row.rs); on
+    TPU we keep codes on device and strings on host, the columnar analog.
+    """
+
+    def __init__(self):
+        self._strings: list[str] = []
+        self._codes: dict[str, int] = {}
+
+    def encode(self, s: str) -> int:
+        code = self._codes.get(s)
+        if code is None:
+            code = len(self._strings)
+            self._strings.append(s)
+            self._codes[s] = code
+        return code
+
+    def encode_many(self, strings) -> np.ndarray:
+        return np.asarray([self.encode(s) for s in strings], dtype=np.int32)
+
+    def decode(self, code: int) -> str:
+        return self._strings[int(code)]
+
+    def decode_many(self, codes) -> list[str]:
+        return [self._strings[int(c)] for c in np.asarray(codes)]
+
+    def __len__(self) -> int:
+        return len(self._strings)
+
+
+# A process-global dictionary registry keyed by (collection, column) is
+# overkill for now: a single shared dictionary per process is correct (codes
+# are only compared for equality) and keeps joins on string columns trivial.
+GLOBAL_DICT = StringDictionary()

@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-virtual-device CPU platform BEFORE the jax
+backend initializes, so multi-chip sharding paths are exercised without TPU
+hardware (the analog of the reference's multi-process tests without a real
+cluster: clusterd-test-driver / mzcompose)."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The axon TPU plugin ignores the JAX_PLATFORMS env var; the config knob wins.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,31 @@
+"""NumPy/pure-Python oracle for differential-collection semantics.
+
+Analog of the reference's datadriven/lowertest oracles
+(doc/developer/101-query-compilation.md:120-128): tests build the same
+collection operation in plain Python dict arithmetic and compare against
+device results.
+"""
+
+from collections import defaultdict
+
+
+def consolidate_rows(rows):
+    """rows: iterable of (col..., time, diff) tuples -> consolidated sorted
+    list of the same shape with zero diffs dropped."""
+    acc = defaultdict(int)
+    for row in rows:
+        *data_time, diff = row
+        acc[tuple(data_time)] += diff
+    out = [
+        (*key, d) for key, d in acc.items() if d != 0
+    ]
+    return sorted(out)
+
+
+def as_multiset(rows):
+    """Collapse times: (col..., time, diff) -> {(col...): total_diff}."""
+    acc = defaultdict(int)
+    for row in rows:
+        *data, _time, diff = row
+        acc[tuple(data)] += diff
+    return {k: v for k, v in acc.items() if v != 0}
