@@ -1,0 +1,118 @@
+"""Arrangements: sorted, consolidated columnar indexes resident in HBM.
+
+Analog of differential arrangements/spines (reference:
+doc/developer/arrangements.md; row-spine/src/lib.rs; shared via
+TraceManager, compute/src/arrangement/manager.rs:33). v0 keeps a single
+fully-consolidated sorted run per arrangement ("fully compacted spine"):
+inserts merge-path + consolidate into a new run. Historical multiversion
+reads are deferred — with barrier-synchronous micro-batch steps every
+reader sees the state exactly at the step frontier, which matches the
+reference's behavior when logical compaction keeps `since` at the frontier.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.consolidate import consolidate
+from ..ops.lanes import key_lanes
+from ..ops.merge import merge_sorted
+from ..ops.search import lex_searchsorted
+from ..ops.sort import apply_perm, sort_perm
+from ..repr.batch import Batch, capacity_tier
+from ..repr.schema import Schema
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Arrangement:
+    """A collection arranged (sorted) by a key-column prefix.
+
+    batch: consolidated (no duplicate rows, nonzero diffs), sorted by
+    key lanes then remaining column lanes. Times in the batch are all
+    forwarded to the arrangement's logical `since` (full logical
+    compaction), so `batch` is exactly the accumulated multiset.
+    """
+
+    batch: Batch
+    key: tuple  # static: key column indices
+
+    def tree_flatten(self):
+        return (self.batch,), (self.key,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        (key,) = aux
+        return cls(children[0], key)
+
+    @property
+    def schema(self) -> Schema:
+        return self.batch.schema
+
+    @property
+    def capacity(self) -> int:
+        return self.batch.capacity
+
+    def sort_lanes(self):
+        """Lanes defining this arrangement's order: key cols first, then
+        all remaining cols (so equal-key rows have deterministic order)."""
+        rest = [
+            i for i in range(self.schema.arity) if i not in self.key
+        ]
+        return key_lanes(self.batch, list(self.key) + rest)
+
+    def key_only_lanes(self):
+        return key_lanes(self.batch, list(self.key))
+
+    @staticmethod
+    def empty(schema: Schema, key, capacity: int = 256) -> "Arrangement":
+        return Arrangement(Batch.empty(schema, capacity), tuple(key))
+
+
+def arrange(batch: Batch, key, capacity: int | None = None) -> Arrangement:
+    """Sort+consolidate a batch into an Arrangement (build from scratch)."""
+    arr = Arrangement(batch, tuple(key))
+    cons = consolidate(batch, include_time=False)
+    arr = Arrangement(cons, tuple(key))
+    perm = sort_perm(arr.sort_lanes(), cons.count, cons.capacity)
+    sorted_batch = apply_perm(cons, perm)
+    if capacity is not None and capacity != sorted_batch.capacity:
+        sorted_batch = sorted_batch.with_capacity(capacity)
+    return Arrangement(sorted_batch, tuple(key))
+
+
+def insert(
+    arr: Arrangement, delta: Batch, out_capacity: int
+) -> tuple[Arrangement, jnp.ndarray]:
+    """Merge a delta batch into the arrangement: the spine 'merge' step.
+
+    Returns (new_arrangement, overflowed). The caller picks `out_capacity`
+    (a tier >= expected survivors); on overflow retry with a larger tier —
+    the exert-proportionality analog is that we always fully compact.
+    """
+    d = arrange(delta, arr.key, capacity=None)
+    merged, overflow = merge_sorted(
+        arr.batch,
+        arr.sort_lanes(),
+        d.batch,
+        d.sort_lanes(),
+        out_capacity,
+    )
+    # Merged runs may contain the same row twice (once per side);
+    # consolidate sums their diffs. Sort order is preserved by
+    # consolidate's stable full-row sort.
+    cons = consolidate(merged, include_time=False)
+    out = Arrangement(cons, arr.key)
+    perm = sort_perm(out.sort_lanes(), cons.count, cons.capacity)
+    return Arrangement(apply_perm(cons, perm), arr.key), overflow
+
+
+def lookup_range(arr: Arrangement, probe_lanes) -> tuple:
+    """For each probe key, the [lo, hi) row range of matching keys."""
+    lanes = arr.key_only_lanes()
+    lo = lex_searchsorted(lanes, arr.batch.count, probe_lanes, side="left")
+    hi = lex_searchsorted(lanes, arr.batch.count, probe_lanes, side="right")
+    return lo, hi

@@ -1,0 +1,136 @@
+"""MapFilterProject: the fused linear operator.
+
+Analog of the reference's ``MapFilterProject`` / ``MfpPlan``
+(src/expr/src/linear.rs:45,1724): a sequence of scalar expressions appended
+as new columns (map), predicates that drop rows (filter), and a final
+column selection (project). MFPs are pushed into sources, joins, and every
+render node; on TPU the whole MFP fuses into one XLA computation over the
+batch, ending in a scatter compaction for the filter.
+
+Temporal predicates on ``mz_now()`` (linear.rs:404-408) are not yet
+implemented (tracked for operator set v1, SURVEY.md §7 step 5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+from ..repr.batch import Batch
+from ..repr.schema import Column, Schema
+from ..ops.sort import compact
+from .scalar import ColumnRef, Evaled, ScalarExpr, eval_expr
+
+
+@dataclass(frozen=True)
+class MapFilterProject:
+    """input_arity -> map expressions -> predicates -> projection.
+
+    Expressions may reference input columns and previously mapped columns
+    (by position input_arity + i), exactly like the reference
+    (linear.rs MapFilterProject docs)."""
+
+    input_arity: int
+    expressions: tuple = ()
+    predicates: tuple = ()
+    projection: tuple | None = None  # None = identity over all columns
+
+    def __init__(self, input_arity, expressions=(), predicates=(), projection=None):
+        object.__setattr__(self, "input_arity", input_arity)
+        object.__setattr__(self, "expressions", tuple(expressions))
+        object.__setattr__(self, "predicates", tuple(predicates))
+        object.__setattr__(
+            self,
+            "projection",
+            tuple(projection) if projection is not None else None,
+        )
+
+    @property
+    def is_identity(self) -> bool:
+        return (
+            not self.expressions
+            and not self.predicates
+            and (
+                self.projection is None
+                or self.projection == tuple(range(self.input_arity))
+            )
+        )
+
+    def output_schema(self, schema: Schema) -> Schema:
+        full = list(schema.columns)
+        for e in self.expressions:
+            full.append(e.typ(Schema(full)))
+        proj = (
+            self.projection
+            if self.projection is not None
+            else range(len(full))
+        )
+        cols = []
+        for i, j in enumerate(proj):
+            c = full[j]
+            cols.append(Column(f"c{i}" if c.name == "f" else c.name,
+                               c.ctype, c.nullable, c.scale))
+        return Schema(cols)
+
+
+def apply_mfp(mfp: MapFilterProject, batch: Batch) -> Batch:
+    """Evaluate the MFP over a batch: fused map+filter+project, compacted."""
+    assert batch.schema.arity == mfp.input_arity, (
+        f"mfp arity {mfp.input_arity} != batch arity {batch.schema.arity}"
+    )
+    if mfp.is_identity:
+        return batch
+
+    # Working set: input columns + mapped columns, with growing schema.
+    work_cols = list(batch.cols)
+    work_nulls = list(batch.nulls)
+    work_schema = list(batch.schema.columns)
+    for e in mfp.expressions:
+        tmp = Batch(
+            cols=tuple(work_cols),
+            nulls=tuple(work_nulls),
+            time=batch.time,
+            diff=batch.diff,
+            count=batch.count,
+            schema=Schema(work_schema),
+        )
+        ev = eval_expr(e, tmp)
+        work_cols.append(ev.values)
+        work_nulls.append(ev.nulls)
+        work_schema.append(ev.col)
+
+    full = Batch(
+        cols=tuple(work_cols),
+        nulls=tuple(work_nulls),
+        time=batch.time,
+        diff=batch.diff,
+        count=batch.count,
+        schema=Schema(work_schema),
+    )
+
+    # Filter: predicate TRUE (not false, not NULL) keeps the row.
+    keep = None
+    for p in mfp.predicates:
+        ev = eval_expr(p, full)
+        ok = jnp.logical_and(ev.values, jnp.logical_not(ev.null_mask()))
+        keep = ok if keep is None else jnp.logical_and(keep, ok)
+
+    # Project.
+    proj = (
+        mfp.projection
+        if mfp.projection is not None
+        else tuple(range(len(work_schema)))
+    )
+    out_schema = mfp.output_schema(batch.schema)
+    projected = Batch(
+        cols=tuple(work_cols[j] for j in proj),
+        nulls=tuple(work_nulls[j] for j in proj),
+        time=batch.time,
+        diff=batch.diff,
+        count=batch.count,
+        schema=out_schema,
+    )
+    if keep is None:
+        return projected
+    return compact(projected, keep)

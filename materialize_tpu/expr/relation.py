@@ -1,0 +1,328 @@
+"""Relation expressions (MIR).
+
+Analog of the reference's ``MirRelationExpr`` — all 15 variants
+(src/expr/src/relation.rs:100): Constant, Get, Let, LetRec, Project, Map,
+FlatMap, Filter, Join, Reduce, TopK, Negate, Threshold, Union, ArrangeBy —
+plus the aggregate function vocabulary (src/expr/src/relation/func.rs:1878
+``AggregateFunc``). The optimizer (materialize_tpu.transform) rewrites
+these; plan.lowering lowers them to LIR for rendering.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+from ..repr.schema import Column, ColumnType, Schema
+from .scalar import ScalarExpr
+
+
+class AggregateFunc(enum.Enum):
+    """Aggregates; accumulable ones fold into the diff field
+    (render/reduce.rs:1357 Accum), hierarchical ones need tournament
+    trees (render/reduce.rs:850)."""
+
+    COUNT = "count"        # accumulable
+    SUM_INT = "sum_int"    # accumulable (int64/decimal)
+    SUM_FLOAT = "sum_float"  # accumulable (f64; non-deterministic order OK)
+    MIN = "min"            # hierarchical
+    MAX = "max"            # hierarchical
+    ANY = "any"            # accumulable over bools (true count > 0)
+    ALL = "all"            # accumulable (false count == 0)
+
+    @property
+    def is_accumulable(self) -> bool:
+        return self in (
+            AggregateFunc.COUNT,
+            AggregateFunc.SUM_INT,
+            AggregateFunc.SUM_FLOAT,
+            AggregateFunc.ANY,
+            AggregateFunc.ALL,
+        )
+
+    @property
+    def is_hierarchical(self) -> bool:
+        return self in (AggregateFunc.MIN, AggregateFunc.MAX)
+
+
+@dataclass(frozen=True)
+class AggregateExpr:
+    """func applied to a scalar expression over the group
+    (reference: expr AggregateExpr {func, expr, distinct})."""
+
+    func: AggregateFunc
+    expr: ScalarExpr
+    distinct: bool = False
+
+    def output_col(self, input_schema: Schema) -> Column:
+        inner = self.expr.typ(input_schema)
+        if self.func is AggregateFunc.COUNT:
+            return Column("count", ColumnType.INT64, False)
+        if self.func is AggregateFunc.SUM_INT:
+            return Column("sum", inner.ctype, True, inner.scale)
+        if self.func is AggregateFunc.SUM_FLOAT:
+            return Column("sum", ColumnType.FLOAT64, True)
+        if self.func in (AggregateFunc.MIN, AggregateFunc.MAX):
+            return Column(
+                self.func.value, inner.ctype, True, inner.scale
+            )
+        if self.func in (AggregateFunc.ANY, AggregateFunc.ALL):
+            return Column(self.func.value, ColumnType.BOOL, True)
+        raise NotImplementedError(self.func)
+
+
+class RelationExpr:
+    """Base class for MIR relation expressions."""
+
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def children(self) -> list["RelationExpr"]:
+        return []
+
+    # builder sugar
+    def project(self, outputs: Sequence[int]) -> "Project":
+        return Project(self, tuple(outputs))
+
+    def map(self, exprs: Sequence[ScalarExpr]) -> "Map":
+        return Map(self, tuple(exprs))
+
+    def filter(self, preds: Sequence[ScalarExpr]) -> "Filter":
+        return Filter(self, tuple(preds))
+
+    def reduce(self, group_key, aggregates) -> "Reduce":
+        return Reduce(self, tuple(group_key), tuple(aggregates))
+
+    def distinct(self) -> "Reduce":
+        return Reduce(
+            self, tuple(range(self.schema().arity)), ()
+        )
+
+    def negate(self) -> "Negate":
+        return Negate(self)
+
+    def threshold(self) -> "Threshold":
+        return Threshold(self)
+
+    def union(self, *others) -> "Union":
+        return Union((self, *others))
+
+    def arrange_by(self, key) -> "ArrangeBy":
+        return ArrangeBy(self, tuple(key))
+
+
+@dataclass(frozen=True)
+class Constant(RelationExpr):
+    """Literal collection: rows with diffs (relation.rs Constant)."""
+
+    rows: tuple  # tuple of (row_tuple, diff)
+    _schema: Schema
+
+    def schema(self):
+        return self._schema
+
+
+@dataclass(frozen=True)
+class Get(RelationExpr):
+    """Reference to a named collection (source, index, or let binding)."""
+
+    name: str
+    _schema: Schema
+
+    def schema(self):
+        return self._schema
+
+
+@dataclass(frozen=True)
+class Let(RelationExpr):
+    name: str
+    value: RelationExpr
+    body: RelationExpr
+
+    def schema(self):
+        return self.body.schema()
+
+    def children(self):
+        return [self.value, self.body]
+
+
+@dataclass(frozen=True)
+class LetRec(RelationExpr):
+    """WITH MUTUALLY RECURSIVE: bindings may reference each other and
+    themselves; semantics are per-binding fixpoint iteration
+    (relation.rs LetRec, rendered at compute render.rs:887)."""
+
+    names: tuple  # binding names
+    values: tuple  # RelationExpr per binding (may Get any binding name)
+    value_schemas: tuple  # declared schema per binding
+    body: RelationExpr
+
+    def schema(self):
+        return self.body.schema()
+
+    def children(self):
+        return list(self.values) + [self.body]
+
+
+@dataclass(frozen=True)
+class Project(RelationExpr):
+    input: RelationExpr
+    outputs: tuple
+
+    def schema(self):
+        return self.input.schema().project(self.outputs)
+
+    def children(self):
+        return [self.input]
+
+
+@dataclass(frozen=True)
+class Map(RelationExpr):
+    input: RelationExpr
+    scalars: tuple
+
+    def schema(self):
+        cols = list(self.input.schema().columns)
+        for e in self.scalars:
+            c = e.typ(Schema(cols))
+            cols.append(Column(f"c{len(cols)}", c.ctype, c.nullable, c.scale))
+        return Schema(cols)
+
+    def children(self):
+        return [self.input]
+
+
+@dataclass(frozen=True)
+class FlatMap(RelationExpr):
+    """Table function application (unnest, generate_series...)."""
+
+    input: RelationExpr
+    func: str
+    exprs: tuple
+    output_cols: tuple  # Columns appended by the table function
+
+    def schema(self):
+        return Schema(
+            tuple(self.input.schema().columns) + tuple(self.output_cols)
+        )
+
+    def children(self):
+        return [self.input]
+
+
+@dataclass(frozen=True)
+class Filter(RelationExpr):
+    input: RelationExpr
+    predicates: tuple
+
+    def schema(self):
+        return self.input.schema()
+
+    def children(self):
+        return [self.input]
+
+
+@dataclass(frozen=True)
+class Join(RelationExpr):
+    """Multiway equi-join. equivalences: classes of scalar expressions
+    (over the concatenated columns of all inputs) asserted equal
+    (relation.rs Join; the optimizer picks Linear vs Delta plans,
+    transform/src/join_implementation.rs)."""
+
+    inputs: tuple
+    equivalences: tuple  # tuple of tuples of ScalarExpr
+
+    def schema(self):
+        cols = []
+        for inp in self.inputs:
+            cols.extend(inp.schema().columns)
+        return Schema(cols)
+
+    def children(self):
+        return list(self.inputs)
+
+
+@dataclass(frozen=True)
+class Reduce(RelationExpr):
+    input: RelationExpr
+    group_key: tuple  # column indices (simple keys; exprs pre-mapped)
+    aggregates: tuple  # AggregateExpr
+
+    def schema(self):
+        in_schema = self.input.schema()
+        cols = [in_schema[i] for i in self.group_key]
+        for j, agg in enumerate(self.aggregates):
+            c = agg.output_col(in_schema)
+            cols.append(Column(f"{c.name}_{j}", c.ctype, c.nullable, c.scale))
+        return Schema(cols)
+
+    def children(self):
+        return [self.input]
+
+
+@dataclass(frozen=True)
+class TopK(RelationExpr):
+    """Per-group top-k by ordering (relation.rs TopK; plans at
+    compute-types/src/plan/top_k.rs:28)."""
+
+    input: RelationExpr
+    group_key: tuple
+    order_by: tuple  # (col_index, desc: bool, nulls_last: bool)
+    limit: int | None
+    offset: int = 0
+
+    def schema(self):
+        return self.input.schema()
+
+    def children(self):
+        return [self.input]
+
+
+@dataclass(frozen=True)
+class Negate(RelationExpr):
+    input: RelationExpr
+
+    def schema(self):
+        return self.input.schema()
+
+    def children(self):
+        return [self.input]
+
+
+@dataclass(frozen=True)
+class Threshold(RelationExpr):
+    """Keep rows with positive multiplicity (render/threshold.rs)."""
+
+    input: RelationExpr
+
+    def schema(self):
+        return self.input.schema()
+
+    def children(self):
+        return [self.input]
+
+
+@dataclass(frozen=True)
+class Union(RelationExpr):
+    inputs: tuple
+
+    def schema(self):
+        return self.inputs[0].schema()
+
+    def children(self):
+        return list(self.inputs)
+
+
+@dataclass(frozen=True)
+class ArrangeBy(RelationExpr):
+    """Assert arrangement by key (relation.rs ArrangeBy)."""
+
+    input: RelationExpr
+    key: tuple  # column indices
+
+    def schema(self):
+        return self.input.schema()
+
+    def children(self):
+        return [self.input]

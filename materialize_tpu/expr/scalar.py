@@ -1,0 +1,479 @@
+"""Scalar expressions (MIR) and their XLA evaluation.
+
+Analog of the reference's ``MirScalarExpr``
+(src/expr/src/scalar.rs:69: Column / Literal / CallUnary / CallBinary /
+CallVariadic / If) and its scalar function library
+(src/expr/src/scalar/func.rs). Where the reference interprets expressions
+row-at-a-time over ``Datum``s, here evaluation happens at *trace time*:
+``eval_expr`` recursively builds a fused XLA computation over whole columns
+— the "MirScalarExpr JIT-compiled to XLA" of the north star
+(BASELINE.json). SQL NULL semantics are carried as an optional bool mask
+per intermediate (three-valued logic).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..repr.batch import Batch
+from ..repr.schema import Column, ColumnType, Schema
+
+# ---------------------------------------------------------------------------
+# Expression tree
+
+
+class ScalarExpr:
+    """Base class. Subclasses are immutable dataclasses."""
+
+    def typ(self, schema: Schema) -> Column:
+        raise NotImplementedError
+
+    # convenience builders
+    def __add__(self, other):
+        return CallBinary(BinaryFunc.ADD, self, _lift(other))
+
+    def __sub__(self, other):
+        return CallBinary(BinaryFunc.SUB, self, _lift(other))
+
+    def __mul__(self, other):
+        return CallBinary(BinaryFunc.MUL, self, _lift(other))
+
+    def eq(self, other):
+        return CallBinary(BinaryFunc.EQ, self, _lift(other))
+
+    def lt(self, other):
+        return CallBinary(BinaryFunc.LT, self, _lift(other))
+
+    def lte(self, other):
+        return CallBinary(BinaryFunc.LTE, self, _lift(other))
+
+    def gt(self, other):
+        return CallBinary(BinaryFunc.GT, self, _lift(other))
+
+    def gte(self, other):
+        return CallBinary(BinaryFunc.GTE, self, _lift(other))
+
+
+def _lift(x) -> "ScalarExpr":
+    if isinstance(x, ScalarExpr):
+        return x
+    if isinstance(x, bool):
+        return Literal(x, ColumnType.BOOL)
+    if isinstance(x, int):
+        return Literal(x, ColumnType.INT64)
+    if isinstance(x, float):
+        return Literal(x, ColumnType.FLOAT64)
+    raise TypeError(x)
+
+
+@dataclass(frozen=True)
+class ColumnRef(ScalarExpr):
+    """Column reference by position (like MirScalarExpr::Column)."""
+
+    index: int
+
+    def typ(self, schema):
+        return schema[self.index]
+
+
+@dataclass(frozen=True)
+class Literal(ScalarExpr):
+    value: Any  # python scalar; None = NULL
+    ctype: ColumnType
+    scale: int = 0
+
+    def typ(self, schema):
+        return Column("literal", self.ctype, self.value is None, self.scale)
+
+
+class UnaryFunc:
+    NOT = "not"
+    NEG = "neg"
+    IS_NULL = "is_null"
+    ABS = "abs"
+    # cast family
+    CAST_INT64 = "cast_int64"
+    CAST_FLOAT64 = "cast_float64"
+    # date parts (DATE = days since epoch)
+    EXTRACT_YEAR = "extract_year"
+
+
+class BinaryFunc:
+    ADD = "add"
+    SUB = "sub"
+    MUL = "mul"
+    DIV = "div"
+    MOD = "mod"
+    EQ = "eq"
+    NEQ = "neq"
+    LT = "lt"
+    LTE = "lte"
+    GT = "gt"
+    GTE = "gte"
+
+
+class VariadicFunc:
+    AND = "and"
+    OR = "or"
+    COALESCE = "coalesce"
+
+
+@dataclass(frozen=True)
+class CallUnary(ScalarExpr):
+    func: str
+    expr: ScalarExpr
+
+    def typ(self, schema):
+        inner = self.expr.typ(schema)
+        if self.func in (UnaryFunc.NOT,):
+            return Column("f", ColumnType.BOOL, inner.nullable)
+        if self.func == UnaryFunc.IS_NULL:
+            return Column("f", ColumnType.BOOL, False)
+        if self.func == UnaryFunc.CAST_INT64:
+            return Column("f", ColumnType.INT64, inner.nullable)
+        if self.func == UnaryFunc.CAST_FLOAT64:
+            return Column("f", ColumnType.FLOAT64, inner.nullable)
+        if self.func == UnaryFunc.EXTRACT_YEAR:
+            return Column("f", ColumnType.INT64, inner.nullable)
+        return inner  # NEG, ABS preserve type
+
+
+@dataclass(frozen=True)
+class CallBinary(ScalarExpr):
+    func: str
+    left: ScalarExpr
+    right: ScalarExpr
+
+    def typ(self, schema):
+        lt_, rt = self.left.typ(schema), self.right.typ(schema)
+        nullable = lt_.nullable or rt.nullable
+        if self.func in (
+            BinaryFunc.EQ,
+            BinaryFunc.NEQ,
+            BinaryFunc.LT,
+            BinaryFunc.LTE,
+            BinaryFunc.GT,
+            BinaryFunc.GTE,
+        ):
+            return Column("f", ColumnType.BOOL, nullable)
+        if self.func == BinaryFunc.DIV:
+            # SQL: division may produce NULL (div by zero -> error in MZ;
+            # we produce NULL for now) and floats for non-decimals.
+            if lt_.ctype is ColumnType.DECIMAL:
+                return Column("f", ColumnType.DECIMAL, True, lt_.scale)
+            return Column("f", ColumnType.FLOAT64, True)
+        # arithmetic: unify types
+        ctype, scale = _unify_arith(lt_, rt, self.func)
+        return Column("f", ctype, nullable, scale)
+
+
+@dataclass(frozen=True)
+class CallVariadic(ScalarExpr):
+    func: str
+    exprs: tuple
+
+    def __init__(self, func, exprs):
+        object.__setattr__(self, "func", func)
+        object.__setattr__(self, "exprs", tuple(exprs))
+
+    def typ(self, schema):
+        if self.func in (VariadicFunc.AND, VariadicFunc.OR):
+            nullable = any(e.typ(schema).nullable for e in self.exprs)
+            return Column("f", ColumnType.BOOL, nullable)
+        if self.func == VariadicFunc.COALESCE:
+            first = self.exprs[0].typ(schema)
+            nullable = all(e.typ(schema).nullable for e in self.exprs)
+            return Column("f", first.ctype, nullable, first.scale)
+        raise NotImplementedError(self.func)
+
+
+@dataclass(frozen=True)
+class If(ScalarExpr):
+    cond: ScalarExpr
+    then: ScalarExpr
+    els: ScalarExpr
+
+    def typ(self, schema):
+        t = self.then.typ(schema)
+        e = self.els.typ(schema)
+        return Column("f", t.ctype, t.nullable or e.nullable, t.scale)
+
+
+def _unify_arith(lt_: Column, rt: Column, func: str) -> tuple[ColumnType, int]:
+    a, b = lt_.ctype, rt.ctype
+    if ColumnType.FLOAT64 in (a, b):
+        return ColumnType.FLOAT64, 0
+    if a is ColumnType.DECIMAL or b is ColumnType.DECIMAL:
+        if func == BinaryFunc.MUL:
+            return ColumnType.DECIMAL, lt_.scale + rt.scale
+        scale = max(lt_.scale, rt.scale)
+        return ColumnType.DECIMAL, scale
+    if a is ColumnType.DATE and b in (ColumnType.INT32, ColumnType.INT64):
+        return ColumnType.DATE, 0
+    if ColumnType.INT64 in (a, b):
+        return ColumnType.INT64, 0
+    return a, 0
+
+
+# ---------------------------------------------------------------------------
+# Evaluation: trace-time construction of fused XLA ops
+
+
+@dataclass
+class Evaled:
+    """An evaluated intermediate: column values + optional null mask."""
+
+    values: jnp.ndarray
+    nulls: jnp.ndarray | None
+    col: Column  # type info
+
+    def null_mask(self) -> jnp.ndarray:
+        if self.nulls is None:
+            return jnp.zeros(self.values.shape, dtype=bool)
+        return self.nulls
+
+
+def _to_decimal_scale(e: Evaled, scale: int) -> jnp.ndarray:
+    """Rescale a decimal (or int) value array to the given decimal scale."""
+    if e.col.ctype is ColumnType.DECIMAL:
+        shift = scale - e.col.scale
+    else:
+        shift = scale
+    v = e.values.astype(jnp.int64)
+    if shift > 0:
+        return v * (10**shift)
+    if shift < 0:
+        return v // (10 ** (-shift))
+    return v
+
+
+def eval_expr(expr: ScalarExpr, batch: Batch) -> Evaled:
+    """Recursively build the XLA computation for `expr` over `batch`."""
+    schema = batch.schema
+    cap = batch.capacity
+
+    if isinstance(expr, ColumnRef):
+        return Evaled(
+            batch.cols[expr.index], batch.nulls[expr.index], schema[expr.index]
+        )
+
+    if isinstance(expr, Literal):
+        col = expr.typ(schema)
+        if expr.value is None:
+            vals = jnp.zeros(cap, dtype=col.dtype)
+            return Evaled(vals, jnp.ones(cap, dtype=bool), col)
+        vals = jnp.full(cap, expr.value, dtype=col.dtype)
+        return Evaled(vals, None, col)
+
+    if isinstance(expr, CallUnary):
+        e = eval_expr(expr.expr, batch)
+        col = expr.typ(schema)
+        f = expr.func
+        if f == UnaryFunc.NOT:
+            return Evaled(jnp.logical_not(e.values), e.nulls, col)
+        if f == UnaryFunc.NEG:
+            return Evaled(-e.values, e.nulls, col)
+        if f == UnaryFunc.ABS:
+            return Evaled(jnp.abs(e.values), e.nulls, col)
+        if f == UnaryFunc.IS_NULL:
+            return Evaled(e.null_mask(), None, col)
+        if f == UnaryFunc.CAST_INT64:
+            if e.col.ctype is ColumnType.DECIMAL:
+                v = e.values // (10**e.col.scale)
+            else:
+                v = e.values.astype(jnp.int64)
+            return Evaled(v, e.nulls, col)
+        if f == UnaryFunc.CAST_FLOAT64:
+            if e.col.ctype is ColumnType.DECIMAL:
+                v = e.values.astype(jnp.float64) / (10.0**e.col.scale)
+            else:
+                v = e.values.astype(jnp.float64)
+            return Evaled(v, e.nulls, col)
+        if f == UnaryFunc.EXTRACT_YEAR:
+            # days-since-epoch -> year; proleptic Gregorian via civil-from-days
+            year = _civil_year_from_days(e.values.astype(jnp.int64))
+            return Evaled(year, e.nulls, col)
+        raise NotImplementedError(f)
+
+    if isinstance(expr, CallBinary):
+        l = eval_expr(expr.left, batch)
+        r = eval_expr(expr.right, batch)
+        col = expr.typ(schema)
+        nulls = _merge_nulls(l, r)
+        f = expr.func
+        if f in (
+            BinaryFunc.EQ,
+            BinaryFunc.NEQ,
+            BinaryFunc.LT,
+            BinaryFunc.LTE,
+            BinaryFunc.GT,
+            BinaryFunc.GTE,
+        ):
+            lv, rv = _coerce_comparable(l, r)
+            op = {
+                BinaryFunc.EQ: jnp.equal,
+                BinaryFunc.NEQ: jnp.not_equal,
+                BinaryFunc.LT: jnp.less,
+                BinaryFunc.LTE: jnp.less_equal,
+                BinaryFunc.GT: jnp.greater,
+                BinaryFunc.GTE: jnp.greater_equal,
+            }[f]
+            return Evaled(op(lv, rv), nulls, col)
+        if col.ctype is ColumnType.DECIMAL:
+            if f == BinaryFunc.MUL:
+                v = l.values.astype(jnp.int64) * r.values.astype(jnp.int64)
+                return Evaled(v, nulls, col)
+            lv = _to_decimal_scale(l, col.scale)
+            rv = _to_decimal_scale(r, col.scale)
+            if f == BinaryFunc.ADD:
+                return Evaled(lv + rv, nulls, col)
+            if f == BinaryFunc.SUB:
+                return Evaled(lv - rv, nulls, col)
+            if f == BinaryFunc.DIV:
+                # decimal / decimal at left scale; NULL on zero divisor
+                zero = rv == 0
+                safe = jnp.where(zero, 1, rv)
+                v = (lv * (10**r.col.scale)) // safe
+                nulls = _or_nulls(nulls, zero)
+                return Evaled(v, nulls, col)
+        if f == BinaryFunc.ADD:
+            return Evaled(l.values + r.values, nulls, col)
+        if f == BinaryFunc.SUB:
+            return Evaled(l.values - r.values, nulls, col)
+        if f == BinaryFunc.MUL:
+            return Evaled(l.values * r.values, nulls, col)
+        if f == BinaryFunc.DIV:
+            lv = _as_float(l)
+            rv = _as_float(r)
+            zero = rv == 0.0
+            v = lv / jnp.where(zero, 1.0, rv)
+            return Evaled(v, _or_nulls(nulls, zero), col)
+        if f == BinaryFunc.MOD:
+            zero = r.values == 0
+            v = jnp.where(zero, 0, l.values % jnp.where(zero, 1, r.values))
+            return Evaled(v, _or_nulls(nulls, zero), col)
+        raise NotImplementedError(f)
+
+    if isinstance(expr, CallVariadic):
+        col = expr.typ(schema)
+        parts = [eval_expr(e, batch) for e in expr.exprs]
+        if expr.func == VariadicFunc.AND:
+            # SQL 3VL: FALSE dominates NULL
+            val = jnp.ones(cap, dtype=bool)
+            known_false = jnp.zeros(cap, dtype=bool)
+            any_null = jnp.zeros(cap, dtype=bool)
+            for p in parts:
+                val = jnp.logical_and(val, p.values)
+                known_false = jnp.logical_or(
+                    known_false,
+                    jnp.logical_and(
+                        jnp.logical_not(p.values),
+                        jnp.logical_not(p.null_mask()),
+                    ),
+                )
+                any_null = jnp.logical_or(any_null, p.null_mask())
+            nulls = jnp.logical_and(any_null, jnp.logical_not(known_false))
+            return Evaled(
+                jnp.logical_and(val, jnp.logical_not(known_false)), nulls, col
+            )
+        if expr.func == VariadicFunc.OR:
+            val = jnp.zeros(cap, dtype=bool)
+            known_true = jnp.zeros(cap, dtype=bool)
+            any_null = jnp.zeros(cap, dtype=bool)
+            for p in parts:
+                val = jnp.logical_or(val, p.values)
+                known_true = jnp.logical_or(
+                    known_true,
+                    jnp.logical_and(p.values, jnp.logical_not(p.null_mask())),
+                )
+                any_null = jnp.logical_or(any_null, p.null_mask())
+            nulls = jnp.logical_and(any_null, jnp.logical_not(known_true))
+            return Evaled(val, nulls, col)
+        if expr.func == VariadicFunc.COALESCE:
+            out_v = parts[-1].values
+            out_n = parts[-1].null_mask()
+            for p in reversed(parts[:-1]):
+                take = jnp.logical_not(p.null_mask())
+                out_v = jnp.where(take, p.values, out_v)
+                out_n = jnp.where(take, jnp.zeros_like(out_n), out_n)
+            return Evaled(out_v, out_n, col)
+        raise NotImplementedError(expr.func)
+
+    if isinstance(expr, If):
+        c = eval_expr(expr.cond, batch)
+        t = eval_expr(expr.then, batch)
+        e = eval_expr(expr.els, batch)
+        col = expr.typ(schema)
+        cond = jnp.logical_and(c.values, jnp.logical_not(c.null_mask()))
+        vals = jnp.where(cond, t.values, e.values)
+        nulls = jnp.where(cond, t.null_mask(), e.null_mask())
+        return Evaled(vals, nulls, col)
+
+    raise NotImplementedError(type(expr))
+
+
+def _merge_nulls(l: Evaled, r: Evaled):
+    if l.nulls is None and r.nulls is None:
+        return None
+    return jnp.logical_or(l.null_mask(), r.null_mask())
+
+
+def _or_nulls(nulls, extra):
+    if nulls is None:
+        return extra
+    return jnp.logical_or(nulls, extra)
+
+
+def _as_float(e: Evaled) -> jnp.ndarray:
+    if e.col.ctype is ColumnType.DECIMAL:
+        return e.values.astype(jnp.float64) / (10.0**e.col.scale)
+    return e.values.astype(jnp.float64)
+
+
+def _coerce_comparable(l: Evaled, r: Evaled):
+    """Align decimal scales / numeric types for comparison."""
+    if (
+        l.col.ctype is ColumnType.DECIMAL
+        or r.col.ctype is ColumnType.DECIMAL
+    ) and ColumnType.FLOAT64 not in (l.col.ctype, r.col.ctype):
+        scale = max(l.col.scale, r.col.scale)
+        return _to_decimal_scale(l, scale), _to_decimal_scale(r, scale)
+    if ColumnType.FLOAT64 in (l.col.ctype, r.col.ctype):
+        return _as_float(l), _as_float(r)
+    return l.values, r.values
+
+
+def _civil_year_from_days(days: jnp.ndarray) -> jnp.ndarray:
+    """Howard Hinnant's civil_from_days, vectorized (year only)."""
+    z = days + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    return jnp.where(m <= 2, y + 1, y)
+
+
+# Convenience helpers for building expressions in tests/plans.
+def col(i: int) -> ColumnRef:
+    return ColumnRef(i)
+
+
+def lit(value, ctype: ColumnType | None = None, scale: int = 0) -> Literal:
+    if ctype is None:
+        return _lift(value)
+    return Literal(value, ctype, scale)
+
+
+def and_(*exprs) -> CallVariadic:
+    return CallVariadic(VariadicFunc.AND, [_lift(e) for e in exprs])
+
+
+def or_(*exprs) -> CallVariadic:
+    return CallVariadic(VariadicFunc.OR, [_lift(e) for e in exprs])

@@ -1,0 +1,348 @@
+"""Accumulable Reduce: incremental GROUP BY with semigroup accumulators.
+
+Analog of the reference's ``ReducePlan::Accumulable``
+(compute-types/src/plan/reduce.rs:230; rendered at
+compute/src/render/reduce.rs:1357 ``build_accumulable``): sums/counts are
+folded into per-group accumulators so an update batch touches each group
+O(1). The group state lives in an Arrangement keyed by the group columns
+with accumulator columns as values:
+
+  [group key cols...] ++ [row_count] ++ per-agg accum cols
+
+Per step: (1) evaluate aggregate input expressions over the delta batch,
+(2) weight by diff and segment-sum per group, (3) gather each touched
+group's old accums from the state arrangement, (4) emit retraction of the
+old output row and insertion of the new one, (5) merge accum deltas into
+the state (summing on key collision, dropping row_count==0 groups).
+
+Exact integer accumulators keep active-active replicas deterministic
+(SURVEY.md §7 hard part #7); SUM(float) accumulates f64 per-group on a
+sorted order, which is deterministic given identical input batches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..arrangement.spine import Arrangement, lookup_range
+from ..expr.relation import AggregateExpr, AggregateFunc
+from ..expr.scalar import eval_expr
+from ..ops.consolidate import consolidate
+from ..ops.lanes import key_lanes
+from ..ops.merge import merge_sorted
+from ..ops.sort import apply_perm, compact, segment_ids, segment_starts, sort_perm
+from ..repr.batch import Batch
+from ..repr.schema import Column, ColumnType, Schema
+
+
+def accum_schema(
+    input_schema: Schema, group_key, aggregates
+) -> Schema:
+    """Schema of the reduce state arrangement."""
+    cols = [input_schema[i] for i in group_key]
+    cols.append(Column("__rows__", ColumnType.INT64))
+    for j, agg in enumerate(aggregates):
+        cols.extend(_accum_cols(j, agg, input_schema))
+    return Schema(cols)
+
+
+def _accum_cols(j: int, agg: AggregateExpr, input_schema: Schema):
+    inner = agg.expr.typ(input_schema)
+    if agg.func is AggregateFunc.COUNT:
+        return [Column(f"__a{j}_count__", ColumnType.INT64)]
+    if agg.func is AggregateFunc.SUM_INT:
+        return [
+            Column(f"__a{j}_sum__", ColumnType.INT64),
+            Column(f"__a{j}_nn__", ColumnType.INT64),
+        ]
+    if agg.func is AggregateFunc.SUM_FLOAT:
+        return [
+            Column(f"__a{j}_sum__", ColumnType.FLOAT64),
+            Column(f"__a{j}_nn__", ColumnType.INT64),
+        ]
+    if agg.func in (AggregateFunc.ANY, AggregateFunc.ALL):
+        return [
+            Column(f"__a{j}_cnt__", ColumnType.INT64),
+            Column(f"__a{j}_nn__", ColumnType.INT64),
+        ]
+    raise NotImplementedError(
+        f"{agg.func} is not accumulable (hierarchical aggregates are "
+        "handled by the bucketed reduce, ops/hierarchy.py)"
+    )
+
+
+def output_schema(input_schema: Schema, group_key, aggregates) -> Schema:
+    cols = [input_schema[i] for i in group_key]
+    for j, agg in enumerate(aggregates):
+        c = agg.output_col(input_schema)
+        # Unique names: several aggregates of the same kind are common.
+        cols.append(Column(f"{c.name}_{j}", c.ctype, c.nullable, c.scale))
+    return Schema(cols)
+
+
+def delta_contributions(
+    batch: Batch, group_key, aggregates, state_schema: Schema
+) -> Batch:
+    """Map an input delta batch to accumulator-contribution rows
+    (one per input row; consolidation groups them)."""
+    cap = batch.capacity
+    cols = [batch.cols[i] for i in group_key]
+    nulls = [batch.nulls[i] for i in group_key]
+    diff = batch.diff
+    cols.append(diff.astype(jnp.int64))  # __rows__
+    nulls.append(None)
+    for agg in aggregates:
+        ev = eval_expr(agg.expr, batch)
+        nn = jnp.logical_not(ev.null_mask())
+        nn_i = nn.astype(jnp.int64) * diff
+        if agg.func is AggregateFunc.COUNT:
+            cols.append(nn_i)
+            nulls.append(None)
+        elif agg.func is AggregateFunc.SUM_INT:
+            v = jnp.where(nn, ev.values.astype(jnp.int64), 0)
+            cols.append(v * diff)
+            nulls.append(None)
+            cols.append(nn_i)
+            nulls.append(None)
+        elif agg.func is AggregateFunc.SUM_FLOAT:
+            v = jnp.where(nn, ev.values.astype(jnp.float64), 0.0)
+            cols.append(v * diff.astype(jnp.float64))
+            nulls.append(None)
+            cols.append(nn_i)
+            nulls.append(None)
+        elif agg.func is AggregateFunc.ANY:
+            t = jnp.logical_and(ev.values, nn).astype(jnp.int64) * diff
+            cols.append(t)
+            nulls.append(None)
+            cols.append(nn_i)
+            nulls.append(None)
+        elif agg.func is AggregateFunc.ALL:
+            f = jnp.logical_and(
+                jnp.logical_not(ev.values), nn
+            ).astype(jnp.int64) * diff
+            cols.append(f)
+            nulls.append(None)
+            cols.append(nn_i)
+            nulls.append(None)
+        else:
+            raise NotImplementedError(agg.func)
+    return Batch(
+        cols=tuple(cols),
+        nulls=tuple(nulls),
+        time=batch.time,
+        # Diff=1 per contribution row: the "diff" of an accum row is
+        # meaningless (accums are summed, not multiset-counted); we sum
+        # accum COLUMNS on key collision instead.
+        diff=jnp.where(batch.valid_mask(), 1, 0).astype(jnp.int64),
+        count=batch.count,
+        schema=state_schema,
+    )
+
+
+def sum_by_key(batch: Batch, n_key: int) -> Batch:
+    """Sort by the first n_key columns and sum ALL remaining (accumulator)
+    columns per key; drop groups whose accums are all untouched rows.
+    Output diff=1 per surviving group row."""
+    cap = batch.capacity
+    lanes = key_lanes(batch, range(n_key))
+    perm = sort_perm(lanes, batch.count, cap)
+    s = apply_perm(batch, perm)
+    lanes = [l[perm] for l in lanes]
+    starts = segment_starts(lanes, s.count, cap)
+    seg = segment_ids(starts)
+    valid = s.valid_mask()
+
+    def seg_sum(col):
+        vals = jnp.where(valid, col, jnp.zeros_like(col))
+        sums = jnp.zeros(cap, dtype=col.dtype).at[seg].add(vals, mode="drop")
+        return sums[seg]
+
+    new_cols = list(s.cols[:n_key]) + [
+        seg_sum(c) for c in s.cols[n_key:]
+    ]
+    out = s.replace(
+        cols=tuple(new_cols),
+        diff=jnp.where(starts, 1, 0).astype(s.diff.dtype),
+    )
+    return compact(out, starts)
+
+
+def merge_accum_state(
+    state: Arrangement, accum_delta: Batch, out_capacity: int
+):
+    """Merge per-group accumulator deltas into the state arrangement,
+    summing accum columns on key collision and dropping dead groups
+    (row_count == 0)."""
+    n_key = len(state.key)
+    d_sorted = sum_by_key(accum_delta, n_key)
+    merged, overflow = merge_sorted(
+        state.batch,
+        key_lanes(state.batch, range(n_key)),
+        d_sorted,
+        key_lanes(d_sorted, range(n_key)),
+        out_capacity,
+    )
+    summed = sum_by_key(merged, n_key)
+    alive = summed.cols[n_key] != 0  # __rows__ > 0 (can't go negative)
+    new_state = compact(summed, alive)
+    return Arrangement(new_state, state.key), overflow
+
+
+def gather_old_accums(state: Arrangement, probe: Batch) -> tuple:
+    """For each probe group row, gather the state's accum columns
+    (zeros if the group is absent). Returns (gathered_cols, found)."""
+    n_key = len(state.key)
+    probe_lanes = key_lanes(probe, range(n_key))
+    lo, hi = lookup_range(state, probe_lanes)
+    found = hi > lo
+    idx = jnp.clip(lo, 0, max(state.capacity - 1, 0))
+    gathered = []
+    for c in state.batch.cols[n_key:]:
+        g = c[idx]
+        gathered.append(jnp.where(found, g, jnp.zeros_like(g)))
+    return gathered, found
+
+
+def accums_to_output(
+    key_cols, key_nulls, accum_cols, aggregates, input_schema: Schema,
+    out_schema: Schema, time, alive, capacity: int,
+) -> tuple:
+    """Convert accumulator columns to an output row per group.
+
+    Returns (cols, nulls) for the output schema; rows where `alive` is
+    False are garbage (caller masks them)."""
+    n_key = len(key_cols)
+    cols = list(key_cols)
+    nulls = list(key_nulls)
+    i = 1  # accum_cols[0] is __rows__
+    for j, agg in enumerate(aggregates):
+        if agg.func is AggregateFunc.COUNT:
+            cols.append(accum_cols[i].astype(jnp.int64))
+            nulls.append(None)
+            i += 1
+        elif agg.func is AggregateFunc.SUM_INT:
+            s, nn = accum_cols[i], accum_cols[i + 1]
+            cols.append(s)
+            nulls.append(nn == 0)
+            i += 2
+        elif agg.func is AggregateFunc.SUM_FLOAT:
+            s, nn = accum_cols[i], accum_cols[i + 1]
+            cols.append(s)
+            nulls.append(nn == 0)
+            i += 2
+        elif agg.func is AggregateFunc.ANY:
+            t, nn = accum_cols[i], accum_cols[i + 1]
+            cols.append(t > 0)
+            nulls.append(nn == 0)
+            i += 2
+        elif agg.func is AggregateFunc.ALL:
+            f, nn = accum_cols[i], accum_cols[i + 1]
+            cols.append(f == 0)
+            nulls.append(nn == 0)
+            i += 2
+        else:
+            raise NotImplementedError(agg.func)
+    return cols, nulls
+
+
+@dataclass
+class ReduceAccumulable:
+    """Static config for one accumulable reduce operator."""
+
+    input_schema: Schema
+    group_key: tuple
+    aggregates: tuple
+
+    def __post_init__(self):
+        self.state_schema = accum_schema(
+            self.input_schema, self.group_key, self.aggregates
+        )
+        self.out_schema = output_schema(
+            self.input_schema, self.group_key, self.aggregates
+        )
+        self.n_key = len(self.group_key)
+
+    def init_state(self, capacity: int = 256) -> Arrangement:
+        return Arrangement.empty(
+            self.state_schema, tuple(range(self.n_key)), capacity
+        )
+
+    def step(
+        self,
+        state: Arrangement,
+        delta: Batch,
+        out_time,
+        state_capacity: int,
+    ):
+        """Process one delta batch.
+
+        Returns (new_state, output_delta_batch, state_overflow).
+        Output capacity = 2 * delta capacity (retraction + insertion per
+        touched group, and touched groups <= delta rows).
+        """
+        contrib = delta_contributions(
+            delta, self.group_key, self.aggregates, self.state_schema
+        )
+        groups = sum_by_key(contrib, self.n_key)  # one row per touched group
+        gcap = groups.capacity
+        gvalid = groups.valid_mask()
+
+        old_accums, _found = gather_old_accums(state, groups)
+        new_accums = [
+            o + d for o, d in zip(old_accums, groups.cols[self.n_key:])
+        ]
+        old_alive = jnp.logical_and(gvalid, old_accums[0] > 0)
+        new_alive = jnp.logical_and(gvalid, new_accums[0] > 0)
+
+        key_cols = groups.cols[: self.n_key]
+        key_nulls = groups.nulls[: self.n_key]
+        time_col = jnp.full(gcap, out_time, dtype=jnp.uint64)
+
+        old_cols, old_nulls = accums_to_output(
+            key_cols, key_nulls, old_accums, self.aggregates,
+            self.input_schema, self.out_schema, out_time, old_alive, gcap,
+        )
+        new_cols, new_nulls = accums_to_output(
+            key_cols, key_nulls, new_accums, self.aggregates,
+            self.input_schema, self.out_schema, out_time, new_alive, gcap,
+        )
+
+        def halves(olds, news):
+            return jnp.concatenate([olds, news])
+
+        out_cols = []
+        out_nulls = []
+        for oc, nc in zip(old_cols, new_cols):
+            out_cols.append(halves(oc, nc))
+        for on, nn in zip(old_nulls, new_nulls):
+            if on is None and nn is None:
+                out_nulls.append(None)
+            else:
+                z = jnp.zeros(gcap, dtype=bool)
+                out_nulls.append(
+                    halves(on if on is not None else z,
+                           nn if nn is not None else z)
+                )
+        out_diff = halves(
+            jnp.where(old_alive, -1, 0).astype(jnp.int64),
+            jnp.where(new_alive, 1, 0).astype(jnp.int64),
+        )
+        keep = out_diff != 0
+        out = Batch(
+            cols=tuple(out_cols),
+            nulls=tuple(out_nulls),
+            time=jnp.concatenate([time_col, time_col]),
+            diff=out_diff,
+            count=jnp.asarray(2 * gcap, dtype=jnp.int32),
+            schema=self.out_schema,
+        )
+        out = compact(out, keep)
+        # Identical retract+insert pairs (group's output unchanged — e.g.
+        # updates that cancel) are removed by consolidation.
+        out = consolidate(out)
+
+        new_state, overflow = merge_accum_state(state, groups, state_capacity)
+        return new_state, out, overflow

@@ -47,9 +47,11 @@ def compact(batch: Batch, keep: jnp.ndarray) -> Batch:
 
     Scatter-based: positions via exclusive cumsum, out-of-range drops.
     """
+    if keep.shape[0] == 0:  # capacity-0 batch: nothing to do
+        return batch
     keep = jnp.logical_and(keep, batch.valid_mask())
     pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-    new_count = jnp.where(keep.shape[0] > 0, pos[-1] + 1, 0).astype(jnp.int32)
+    new_count = (pos[-1] + 1).astype(jnp.int32)
     cap = batch.capacity
     dest = jnp.where(keep, pos, cap)  # cap is out of range -> dropped
 

@@ -1,0 +1,274 @@
+"""Render: MIR relation expressions -> one jitted XLA step function.
+
+Analog of the reference's render layer (compute/src/render.rs:202
+``build_compute_dataflow``, :1155 ``render_plan_expr``), re-cast for TPU:
+instead of building a graph of timely operators that run cooperatively,
+rendering builds ONE pure function
+
+    step(states, inputs, time) -> (output_delta, new_states, overflows)
+
+that XLA compiles once per capacity signature and the host calls per
+micro-batch (barrier-synchronous execution, SURVEY.md §7 design stance).
+Stateful operators (Reduce, and later Join/TopK/Threshold) own slots in
+the `states` tuple (Arrangements). Capacity overflow is detected on device
+and resolved host-side by growing the state tier and retrying the step —
+the compile-cache-per-capacity-tier scheme.
+
+The ``Dataflow`` wrapper owns the host side: frontier/time advancement,
+jit caching, overflow retries, and the output arrangement serving peeks
+(the TraceManager + handle_peek analog, compute/src/compute_state.rs:744).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..arrangement.spine import Arrangement, arrange, insert
+from ..expr import relation as mir
+from ..expr.linear import MapFilterProject, apply_mfp
+from ..ops.consolidate import consolidate
+from ..ops.reduce import ReduceAccumulable
+from ..repr.batch import Batch, capacity_tier
+from ..repr.schema import Schema
+
+
+def concat_batches(batches: list[Batch]) -> Batch:
+    """Concatenate batches of the same schema (capacity = sum of caps).
+    Valid rows are NOT contiguous across parts, so this compacts."""
+    assert batches
+    if len(batches) == 1:
+        return batches[0]
+    schema = batches[0].schema
+    cap = sum(b.capacity for b in batches)
+
+    def cat(field):
+        parts = [field(b) for b in batches]
+        if any(p is None for p in parts):
+            parts = [
+                p
+                if p is not None
+                else jnp.zeros(b.capacity, dtype=bool)
+                for p, b in zip(parts, batches)
+            ]
+        return jnp.concatenate(parts)
+
+    keep = jnp.concatenate([b.valid_mask() for b in batches])
+    out = Batch(
+        cols=tuple(
+            cat(lambda b, i=i: b.cols[i]) for i in range(schema.arity)
+        ),
+        nulls=tuple(
+            (
+                None
+                if all(b.nulls[i] is None for b in batches)
+                else cat(lambda b, i=i: b.nulls[i])
+            )
+            for i in range(schema.arity)
+        ),
+        time=cat(lambda b: b.time),
+        diff=cat(lambda b: b.diff),
+        count=jnp.asarray(cap, dtype=jnp.int32),
+        schema=schema,
+    )
+    from ..ops.sort import compact
+
+    return compact(out, keep)
+
+
+@dataclass
+class _StateSlot:
+    index: int
+    init: Arrangement
+
+
+class _RenderContext:
+    """Collects state slots while walking the MIR tree (one walk at trace
+    time per compilation)."""
+
+    def __init__(self, source_schemas: dict):
+        self.source_schemas = source_schemas
+        self.slots: list[_StateSlot] = []
+        self.operators: list = []  # parallel to slots: op configs
+
+    def new_slot(self, op, init: Arrangement) -> int:
+        idx = len(self.slots)
+        self.slots.append(_StateSlot(idx, init))
+        self.operators.append(op)
+        return idx
+
+
+def _build(expr: mir.RelationExpr, ctx: _RenderContext):
+    """Returns a closure (states, inputs, time) -> (delta_batch,
+    state_updates: dict slot->new_state, overflow_flags: list)."""
+
+    if isinstance(expr, mir.Get):
+        name = expr.name
+
+        def run(states, inputs, time):
+            return inputs[name], {}, []
+
+        return run
+
+    if isinstance(expr, mir.Project):
+        inner = _build(expr.input, ctx)
+        mfp = MapFilterProject(
+            expr.input.schema().arity, projection=expr.outputs
+        )
+
+        def run(states, inputs, time):
+            b, upd, ovf = inner(states, inputs, time)
+            return apply_mfp(mfp, b), upd, ovf
+
+        return run
+
+    if isinstance(expr, mir.Map):
+        inner = _build(expr.input, ctx)
+        mfp = MapFilterProject(
+            expr.input.schema().arity, expressions=expr.scalars
+        )
+
+        def run(states, inputs, time):
+            b, upd, ovf = inner(states, inputs, time)
+            return apply_mfp(mfp, b), upd, ovf
+
+        return run
+
+    if isinstance(expr, mir.Filter):
+        inner = _build(expr.input, ctx)
+        mfp = MapFilterProject(
+            expr.input.schema().arity, predicates=expr.predicates
+        )
+
+        def run(states, inputs, time):
+            b, upd, ovf = inner(states, inputs, time)
+            return apply_mfp(mfp, b), upd, ovf
+
+        return run
+
+    if isinstance(expr, mir.Negate):
+        inner = _build(expr.input, ctx)
+
+        def run(states, inputs, time):
+            b, upd, ovf = inner(states, inputs, time)
+            return b.replace(diff=-b.diff), upd, ovf
+
+        return run
+
+    if isinstance(expr, mir.Union):
+        inners = [_build(i, ctx) for i in expr.inputs]
+
+        def run(states, inputs, time):
+            parts, upd, ovf = [], {}, []
+            for f in inners:
+                b, u, o = f(states, inputs, time)
+                parts.append(b)
+                upd.update(u)
+                ovf.extend(o)
+            return concat_batches(parts), upd, ovf
+
+        return run
+
+    if isinstance(expr, mir.Reduce):
+        op = ReduceAccumulable(
+            expr.input.schema(), expr.group_key, expr.aggregates
+        )
+        slot = ctx.new_slot(op, op.init_state())
+        inner = _build(expr.input, ctx)
+
+        def run(states, inputs, time):
+            b, upd, ovf = inner(states, inputs, time)
+            state = states[slot]
+            new_state, out, overflow = op.step(
+                state, b, time, state.capacity
+            )
+            upd = dict(upd)
+            upd[slot] = new_state
+            return out, upd, ovf + [overflow]
+
+        return run
+
+    raise NotImplementedError(
+        f"render: {type(expr).__name__} not supported in operator set v0"
+    )
+
+
+class Dataflow:
+    """A maintained dataflow: install once, feed update batches, peek.
+
+    The host-side analog of an installed DataflowDescription with an
+    index export (compute-types/src/dataflows.rs:32): output deltas are
+    merged into an output arrangement that serves peeks.
+    """
+
+    def __init__(self, expr: mir.RelationExpr, name: str = "df"):
+        self.expr = expr
+        self.name = name
+        self.out_schema = expr.schema()
+        ctx = _RenderContext({})
+        self._run = _build(expr, ctx)
+        self._ctx = ctx
+        self.states = [s.init for s in ctx.slots]
+        out_key = tuple(range(self.out_schema.arity))
+        self.output = Arrangement.empty(self.out_schema, out_key)
+        self.time = 0  # frontier: all steps < time are complete
+        self._step_jit = jax.jit(self._step_core)
+        self._insert_jit = jax.jit(insert, static_argnames=("out_capacity",))
+
+    # pure, jitted once per capacity signature
+    def _step_core(self, states, inputs, time):
+        out, upd, ovf = self._run(states, inputs, time)
+        out = consolidate(out)
+        new_states = list(states)
+        for k, v in upd.items():
+            new_states[k] = v
+        return out, tuple(new_states), ovf
+
+    def step(self, inputs: dict) -> Batch:
+        """Feed one micro-batch of updates per source; returns the output
+        delta at this step's timestamp and advances the frontier."""
+        t = jnp.asarray(self.time, dtype=jnp.uint64)
+        while True:
+            out, new_states, ovf = self._step_jit(
+                tuple(self.states), inputs, t
+            )
+            if ovf and any(bool(o) for o in ovf):
+                # Grow every overflowed state to the next tier and retry;
+                # states were not committed, so the retry is idempotent.
+                grown = []
+                for s, o in zip(self.states, ovf):
+                    if bool(o):
+                        s = Arrangement(
+                            s.batch.with_capacity(s.batch.capacity * 2),
+                            s.key,
+                        )
+                    grown.append(s)
+                self.states = grown
+                continue
+            break
+        self.states = list(new_states)
+
+        # Maintain the output arrangement (index on the MV).
+        while True:
+            new_out, ovf = self._insert_jit(
+                self.output, out, out_capacity=self.output.capacity
+            )
+            if bool(ovf):
+                self.output = Arrangement(
+                    self.output.batch.with_capacity(
+                        self.output.capacity * 2
+                    ),
+                    self.output.key,
+                )
+                continue
+            break
+        self.output = new_out
+        self.time += 1
+        return out
+
+    def peek(self) -> list[tuple]:
+        """Read the full maintained result (SELECT * FROM mv)."""
+        return self.output.batch.to_rows()

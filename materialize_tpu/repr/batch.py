@@ -136,20 +136,29 @@ class Batch:
 
     # -- host readback -----------------------------------------------------
     def to_numpy(self) -> dict:
-        """Read valid rows back to host as a dict of numpy arrays."""
+        """Read valid rows back to host as a dict of numpy arrays.
+        Duplicate column names are disambiguated with a positional suffix —
+        use positional access (to_columns/to_rows) when names may repeat."""
         n = int(self.count)
         out = {}
-        for c, arr in zip(self.schema.columns, self.cols):
-            out[c.name] = np.asarray(arr)[:n]
+        for i, (c, arr) in enumerate(zip(self.schema.columns, self.cols)):
+            name = c.name if c.name not in out else f"{c.name}__{i}"
+            out[name] = np.asarray(arr)[:n]
         out["__time__"] = np.asarray(self.time)[:n]
         out["__diff__"] = np.asarray(self.diff)[:n]
         return out
 
+    def to_columns(self) -> list[np.ndarray]:
+        """Valid rows of every column, positionally, + time and diff."""
+        n = int(self.count)
+        return [np.asarray(a)[:n] for a in self.cols] + [
+            np.asarray(self.time)[:n],
+            np.asarray(self.diff)[:n],
+        ]
+
     def to_rows(self) -> list[tuple]:
         """Valid rows as python tuples (col..., time, diff) — for tests."""
-        d = self.to_numpy()
-        names = list(self.schema.names)
-        cols = [d[n] for n in names] + [d["__time__"], d["__diff__"]]
+        cols = self.to_columns()
         return [tuple(x.item() for x in row) for row in zip(*cols)]
 
     # -- shape management --------------------------------------------------

@@ -1,0 +1,321 @@
+"""TPC-H load generator: deterministic, vectorized, host-side.
+
+Analog of the reference's TPCH load-generator source
+(src/storage/src/source/generator/tpch.rs): emits the TPC-H tables as an
+initial snapshot of inserts, then (like the reference's tick mode) churns
+orders — deleting and re-inserting order/lineitem groups — to produce a
+sustained update stream. Distributions are the simplified deterministic
+ones the reference uses, not the official dbgen text generator: uniform
+keys/quantities/discounts, date ranges over 1992-1998.
+
+All columns that the north-star workloads touch are generated with correct
+types (DECIMAL as scaled int64, DATE as days-since-epoch, flags as
+dictionary-coded strings); long text columns (comments) are omitted — they
+are dead weight for every benchmark query.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ...repr.batch import Batch
+from ...repr.schema import (
+    GLOBAL_DICT,
+    Column,
+    ColumnType,
+    Schema,
+)
+
+_EPOCH_1992 = 8035  # days from 1970-01-01 to 1992-01-01
+_DATE_RANGE = 2526  # days spanned by TPCH dates (1992-01-01..1998-12-01)
+
+LINEITEM_SCHEMA = Schema(
+    [
+        Column("l_orderkey", ColumnType.INT64),
+        Column("l_partkey", ColumnType.INT64),
+        Column("l_suppkey", ColumnType.INT64),
+        Column("l_linenumber", ColumnType.INT32),
+        Column("l_quantity", ColumnType.DECIMAL, scale=2),
+        Column("l_extendedprice", ColumnType.DECIMAL, scale=2),
+        Column("l_discount", ColumnType.DECIMAL, scale=2),
+        Column("l_tax", ColumnType.DECIMAL, scale=2),
+        Column("l_returnflag", ColumnType.STRING),
+        Column("l_linestatus", ColumnType.STRING),
+        Column("l_shipdate", ColumnType.DATE),
+        Column("l_commitdate", ColumnType.DATE),
+        Column("l_receiptdate", ColumnType.DATE),
+    ]
+)
+
+ORDERS_SCHEMA = Schema(
+    [
+        Column("o_orderkey", ColumnType.INT64),
+        Column("o_custkey", ColumnType.INT64),
+        Column("o_orderstatus", ColumnType.STRING),
+        Column("o_totalprice", ColumnType.DECIMAL, scale=2),
+        Column("o_orderdate", ColumnType.DATE),
+        Column("o_orderpriority", ColumnType.STRING),
+    ]
+)
+
+SUPPLIER_SCHEMA = Schema(
+    [
+        Column("s_suppkey", ColumnType.INT64),
+        Column("s_nationkey", ColumnType.INT64),
+        Column("s_name", ColumnType.STRING),
+    ]
+)
+
+PART_SCHEMA = Schema(
+    [
+        Column("p_partkey", ColumnType.INT64),
+        Column("p_name", ColumnType.STRING),
+        Column("p_retailprice", ColumnType.DECIMAL, scale=2),
+    ]
+)
+
+PARTSUPP_SCHEMA = Schema(
+    [
+        Column("ps_partkey", ColumnType.INT64),
+        Column("ps_suppkey", ColumnType.INT64),
+        Column("ps_supplycost", ColumnType.DECIMAL, scale=2),
+    ]
+)
+
+CUSTOMER_SCHEMA = Schema(
+    [
+        Column("c_custkey", ColumnType.INT64),
+        Column("c_nationkey", ColumnType.INT64),
+        Column("c_name", ColumnType.STRING),
+    ]
+)
+
+NATION_SCHEMA = Schema(
+    [
+        Column("n_nationkey", ColumnType.INT64),
+        Column("n_regionkey", ColumnType.INT64),
+        Column("n_name", ColumnType.STRING),
+    ]
+)
+
+REGION_SCHEMA = Schema(
+    [
+        Column("r_regionkey", ColumnType.INT64),
+        Column("r_name", ColumnType.STRING),
+    ]
+)
+
+_NATIONS = [
+    "ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+    "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN", "IRAQ", "JAPAN",
+    "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE", "PERU", "CHINA",
+    "ROMANIA", "SAUDI ARABIA", "VIETNAM", "RUSSIA", "UNITED KINGDOM",
+    "UNITED STATES",
+]
+_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+_NATION_REGION = [0, 1, 1, 1, 4, 0, 3, 3, 2, 2, 4, 4, 2, 4, 0, 0, 0, 1,
+                  2, 3, 4, 2, 3, 3, 1]
+
+
+@dataclass
+class TpchGenerator:
+    """Deterministic TPCH generator at a given scale factor.
+
+    Row counts follow the spec: orders = 1.5M * sf, lineitem ~ 4 per
+    order, part = 200k * sf, supplier = 10k * sf, customer = 150k * sf.
+    """
+
+    sf: float = 0.01
+    seed: int = 1
+
+    def __post_init__(self):
+        self.n_orders = max(int(1_500_000 * self.sf), 16)
+        self.n_part = max(int(200_000 * self.sf), 8)
+        self.n_supplier = max(int(10_000 * self.sf), 4)
+        self.n_customer = max(int(150_000 * self.sf), 8)
+        self._flag_codes = GLOBAL_DICT.encode_many(["R", "A", "N"])
+        self._status_codes = GLOBAL_DICT.encode_many(["F", "O"])
+        self._prio_codes = GLOBAL_DICT.encode_many(
+            ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+        )
+
+    # -- per-order generation (deterministic in orderkey) -------------------
+    def _order_rng(self, orderkeys: np.ndarray) -> np.random.Generator:
+        # Deterministic per-batch: seeded from the key block.
+        return np.random.default_rng(
+            self.seed * 1_000_003 + int(orderkeys[0]) if len(orderkeys) else 0
+        )
+
+    def lineitems_for_orders(self, orderkeys: np.ndarray):
+        """Generate lineitem rows for the given order keys.
+
+        Returns (cols list, per-row orderkey index) matching
+        LINEITEM_SCHEMA order.
+        """
+        rng = self._order_rng(orderkeys)
+        n_lines = rng.integers(1, 8, size=len(orderkeys))  # avg 4, per spec
+        okeys = np.repeat(orderkeys, n_lines)
+        n = len(okeys)
+        linenumber = (
+            np.arange(n) - np.repeat(np.cumsum(n_lines) - n_lines, n_lines)
+        ).astype(np.int32) + 1
+        partkey = rng.integers(1, self.n_part + 1, size=n)
+        suppkey = rng.integers(1, self.n_supplier + 1, size=n)
+        quantity = rng.integers(1, 51, size=n) * 100  # 1..50, scale 2
+        retail = 90_000 + (partkey * 100) % 200_000 + (partkey % 1000) * 100
+        extendedprice = (quantity // 100) * retail
+        discount = rng.integers(0, 11, size=n)  # 0.00..0.10
+        tax = rng.integers(0, 9, size=n)  # 0.00..0.08
+        orderdate = _EPOCH_1992 + (
+            (okeys * 2654435761) % (_DATE_RANGE - 151)
+        ).astype(np.int64)
+        shipdate = orderdate + rng.integers(1, 122, size=n)
+        commitdate = orderdate + rng.integers(30, 91, size=n)
+        receiptdate = shipdate + rng.integers(1, 31, size=n)
+        today = _EPOCH_1992 + _DATE_RANGE - 151
+        returnflag = np.where(
+            receiptdate <= today,
+            self._flag_codes[rng.integers(0, 2, size=n)],
+            self._flag_codes[2],
+        ).astype(np.int32)
+        linestatus = np.where(
+            shipdate > today, self._status_codes[1], self._status_codes[0]
+        ).astype(np.int32)
+        cols = [
+            okeys,
+            partkey,
+            suppkey,
+            linenumber,
+            quantity.astype(np.int64),
+            extendedprice.astype(np.int64),
+            (discount).astype(np.int64),
+            (tax).astype(np.int64),
+            returnflag,
+            linestatus,
+            shipdate.astype(np.int32),
+            commitdate.astype(np.int32),
+            receiptdate.astype(np.int32),
+        ]
+        return cols
+
+    def orders_rows(self, orderkeys: np.ndarray):
+        rng = self._order_rng(orderkeys)
+        n = len(orderkeys)
+        custkey = rng.integers(1, self.n_customer + 1, size=n)
+        status = self._status_codes[rng.integers(0, 2, size=n)].astype(
+            np.int32
+        )
+        totalprice = rng.integers(1_000_00, 500_000_00, size=n)
+        orderdate = _EPOCH_1992 + (
+            (orderkeys * 2654435761) % (_DATE_RANGE - 151)
+        ).astype(np.int64)
+        prio = self._prio_codes[rng.integers(0, 5, size=n)].astype(np.int32)
+        return [
+            orderkeys,
+            custkey,
+            status,
+            totalprice.astype(np.int64),
+            orderdate.astype(np.int32),
+            prio,
+        ]
+
+    # -- static dimension tables -------------------------------------------
+    def supplier_table(self):
+        rng = np.random.default_rng(self.seed + 7)
+        keys = np.arange(1, self.n_supplier + 1)
+        nation = rng.integers(0, 25, size=len(keys))
+        names = GLOBAL_DICT.encode_many(
+            [f"Supplier#{k:09d}" for k in keys]
+        )
+        return [keys, nation.astype(np.int64), names]
+
+    def part_table(self):
+        keys = np.arange(1, self.n_part + 1)
+        names = GLOBAL_DICT.encode_many([f"part {k % 92}" for k in keys])
+        retail = (
+            90_000 + (keys * 100) % 200_000 + (keys % 1000) * 100
+        ).astype(np.int64)
+        return [keys, names, retail]
+
+    def partsupp_table(self):
+        rng = np.random.default_rng(self.seed + 11)
+        pkeys = np.repeat(np.arange(1, self.n_part + 1), 4)
+        skeys = (
+            (pkeys + np.tile(np.arange(4), self.n_part) * (
+                self.n_supplier // 4 + 1
+            )) % self.n_supplier
+        ) + 1
+        cost = rng.integers(100, 1000_00, size=len(pkeys)).astype(np.int64)
+        return [pkeys, skeys, cost]
+
+    def customer_table(self):
+        rng = np.random.default_rng(self.seed + 13)
+        keys = np.arange(1, self.n_customer + 1)
+        nation = rng.integers(0, 25, size=len(keys))
+        names = GLOBAL_DICT.encode_many(
+            [f"Customer#{k:09d}" for k in keys]
+        )
+        return [keys, nation.astype(np.int64), names]
+
+    def nation_table(self):
+        names = GLOBAL_DICT.encode_many(_NATIONS)
+        return [
+            np.arange(25, dtype=np.int64),
+            np.asarray(_NATION_REGION, dtype=np.int64),
+            names,
+        ]
+
+    def region_table(self):
+        names = GLOBAL_DICT.encode_many(_REGIONS)
+        return [np.arange(5, dtype=np.int64), names]
+
+    # -- streaming interface ------------------------------------------------
+    def snapshot_lineitem_batches(
+        self, batch_orders: int = 4096, time: int = 0
+    ):
+        """Yield Batch objects of lineitem inserts covering the snapshot."""
+        for start in range(1, self.n_orders + 1, batch_orders):
+            keys = np.arange(
+                start, min(start + batch_orders, self.n_orders + 1)
+            )
+            cols = self.lineitems_for_orders(keys)
+            n = len(cols[0])
+            yield Batch.from_numpy(
+                LINEITEM_SCHEMA,
+                cols,
+                np.full(n, time, np.uint64),
+                np.ones(n, np.int64),
+            )
+
+    def churn_lineitem_batch(
+        self, n_orders: int, tick: int, time: int, capacity: int | None = None
+    ) -> Batch:
+        """One tick of order churn: delete + regenerate `n_orders` orders'
+        lineitems (the reference's tick loop deletes and re-inserts an
+        order per tick, tpch.rs)."""
+        rng = np.random.default_rng(self.seed * 31 + tick)
+        keys = np.sort(
+            rng.choice(
+                np.arange(1, self.n_orders + 1), size=n_orders, replace=False
+            )
+        )
+        old = self.lineitems_for_orders(keys)
+        # regenerated with a different per-tick seed: mutate quantities etc.
+        self2 = TpchGenerator(self.sf, self.seed + 1000 + tick)
+        self2.n_part, self2.n_supplier, self2.n_customer = (
+            self.n_part,
+            self.n_supplier,
+            self.n_customer,
+        )
+        new = self2.lineitems_for_orders(keys)
+        cols = [np.concatenate([o, nw]) for o, nw in zip(old, new)]
+        n_old, n_new = len(old[0]), len(new[0])
+        diffs = np.concatenate(
+            [np.full(n_old, -1, np.int64), np.ones(n_new, np.int64)]
+        )
+        times = np.full(n_old + n_new, time, np.uint64)
+        return Batch.from_numpy(
+            LINEITEM_SCHEMA, cols, times, diffs, capacity=capacity
+        )

@@ -1,0 +1,203 @@
+"""End-to-end dataflow tests: MFP, accumulable Reduce, and TPCH Q1
+maintained incrementally — the minimum end-to-end slice of SURVEY.md §7
+step 2, checked against a host-side oracle."""
+
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+from materialize_tpu.expr import relation as mir
+from materialize_tpu.expr.linear import MapFilterProject, apply_mfp
+from materialize_tpu.expr.relation import AggregateExpr, AggregateFunc
+from materialize_tpu.expr.scalar import col, lit
+from materialize_tpu.render.dataflow import Dataflow
+from materialize_tpu.repr.batch import Batch
+from materialize_tpu.repr.schema import Column, ColumnType, Schema
+from materialize_tpu.storage.generator.tpch import (
+    LINEITEM_SCHEMA,
+    TpchGenerator,
+)
+
+from .oracle import as_multiset
+
+
+def _mk_batch(schema, cols, diffs, time=0):
+    n = len(diffs)
+    return Batch.from_numpy(
+        schema, cols, np.full(n, time, np.uint64), np.asarray(diffs)
+    )
+
+
+class TestMfp:
+    def test_map_filter_project(self):
+        schema = Schema(
+            [Column("a", ColumnType.INT64), Column("b", ColumnType.INT64)]
+        )
+        b = _mk_batch(
+            schema,
+            [np.arange(10), np.arange(10) * 10],
+            np.ones(10, np.int64),
+        )
+        mfp = MapFilterProject(
+            2,
+            expressions=[col(0) + col(1)],  # c = a + b
+            predicates=[col(0).gte(3)],
+            projection=[2, 0],
+        )
+        out = apply_mfp(mfp, b)
+        rows = out.to_rows()
+        assert rows == [(i * 11, i, 0, 1) for i in range(3, 10)]
+
+    def test_filter_null_is_not_true(self):
+        schema = Schema([Column("a", ColumnType.INT64, nullable=True)])
+        b = Batch.from_numpy(
+            schema,
+            [np.array([1, 2, 3])],
+            np.zeros(3, np.uint64),
+            np.ones(3, np.int64),
+            nulls=[np.array([False, True, False])],
+        )
+        mfp = MapFilterProject(1, predicates=[col(0).gte(0)])
+        out = apply_mfp(mfp, b)
+        assert [r[0] for r in out.to_rows()] == [1, 3]
+
+
+class TestReduceDataflow:
+    def _dataflow(self):
+        schema = Schema(
+            [Column("k", ColumnType.INT64), Column("v", ColumnType.INT64)]
+        )
+        expr = mir.Get("in", schema).reduce(
+            (0,),
+            (
+                AggregateExpr(AggregateFunc.SUM_INT, col(1)),
+                AggregateExpr(AggregateFunc.COUNT, col(1)),
+            ),
+        )
+        return schema, Dataflow(expr)
+
+    def test_incremental_groupby_matches_oracle(self):
+        schema, df = self._dataflow()
+        rng = np.random.default_rng(5)
+        oracle_rows = []
+        for step in range(4):
+            n = 200
+            k = rng.integers(0, 10, n)
+            v = rng.integers(-50, 50, n)
+            d = rng.integers(-1, 2, n)
+            d[d == 0] = 1
+            b = _mk_batch(schema, [k, v], d, time=step)
+            df.step({"in": b})
+            oracle_rows += b.to_rows()
+
+        # oracle: group k -> (sum, count) over the accumulated multiset
+        ms = as_multiset(oracle_rows)
+        want = {}
+        for (k, v), c in ms.items():
+            s, n = want.get(k, (0, 0))
+            want[k] = (s + v * c, n + c)
+        want = sorted(
+            (k, s, n) for k, (s, n) in want.items() if n != 0
+        )
+        got = sorted((r[0], r[1], r[2]) for r in df.peek())
+        assert got == want
+
+    def test_groups_vanish_on_full_retraction(self):
+        schema, df = self._dataflow()
+        b1 = _mk_batch(schema, [np.array([1, 1, 2]), np.array([5, 6, 7])],
+                       [1, 1, 1], time=0)
+        df.step({"in": b1})
+        assert len(df.peek()) == 2
+        b2 = _mk_batch(schema, [np.array([1, 1]), np.array([5, 6])],
+                       [-1, -1], time=1)
+        df.step({"in": b2})
+        rows = df.peek()
+        assert [(r[0], r[1], r[2]) for r in rows] == [(2, 7, 1)]
+
+    def test_output_deltas_are_minimal(self):
+        schema, df = self._dataflow()
+        b1 = _mk_batch(schema, [np.array([1, 2]), np.array([5, 7])],
+                       [1, 1], time=0)
+        df.step({"in": b1})
+        # step that doesn't change group 2 must not emit deltas for it
+        b2 = _mk_batch(schema, [np.array([1]), np.array([3])], [1], time=1)
+        out = df.step({"in": b2})
+        touched = {r[0] for r in out.to_rows()}
+        assert touched == {1}
+
+
+def tpch_q1_mir():
+    """TPCH Q1 as MIR over the lineitem schema (sums; avgs derive from
+    sums/counts in finishing)."""
+    sch = LINEITEM_SCHEMA
+    i = sch.index_of
+    cutoff = 8035 + 2526 - 90  # date '1998-12-01' - 90 days, as day number
+    one = lit(100, ColumnType.DECIMAL, 2)  # 1.00
+    disc_price = col(i("l_extendedprice")) * (one - col(i("l_discount")))
+    charge_rhs = one + col(i("l_tax"))
+    expr = (
+        mir.Get("lineitem", sch)
+        .filter([col(i("l_shipdate")).lte(lit(cutoff, ColumnType.DATE))])
+        .map([disc_price])  # -> col 13, scale 4
+        .map([col(13) * charge_rhs])  # -> col 14, scale 6
+        .project([i("l_returnflag"), i("l_linestatus"),
+                  i("l_quantity"), i("l_extendedprice"), 13, 14])
+        .reduce(
+            (0, 1),
+            (
+                AggregateExpr(AggregateFunc.SUM_INT, col(2)),  # sum_qty
+                AggregateExpr(AggregateFunc.SUM_INT, col(3)),  # sum_base
+                AggregateExpr(AggregateFunc.SUM_INT, col(4)),  # sum_disc
+                AggregateExpr(AggregateFunc.SUM_INT, col(5)),  # sum_charge
+                AggregateExpr(AggregateFunc.COUNT, lit(True)),  # count(*)
+            ),
+        )
+    )
+    return expr
+
+
+def q1_oracle(rows, cutoff):
+    """rows: lineitem (col..., time, diff) tuples."""
+    sch = LINEITEM_SCHEMA
+    idx = {c.name: i for i, c in enumerate(sch.columns)}
+    ms = as_multiset(rows)
+    acc = defaultdict(lambda: [0, 0, 0, 0, 0])
+    for data, c in ms.items():
+        if data[idx["l_shipdate"]] > cutoff:
+            continue
+        key = (data[idx["l_returnflag"]], data[idx["l_linestatus"]])
+        qty = data[idx["l_quantity"]]
+        ep = data[idx["l_extendedprice"]]
+        disc = data[idx["l_discount"]]
+        tax = data[idx["l_tax"]]
+        disc_price = ep * (100 - disc)
+        charge = disc_price * (100 + tax)
+        a = acc[key]
+        a[0] += qty * c
+        a[1] += ep * c
+        a[2] += disc_price * c
+        a[3] += charge * c
+        a[4] += c
+    return sorted(
+        (k + tuple(v)) for k, v in acc.items() if v[4] != 0
+    )
+
+
+class TestTpchQ1:
+    def test_q1_maintained_incrementally(self):
+        gen = TpchGenerator(sf=0.001, seed=3)
+        df = Dataflow(tpch_q1_mir())
+        cutoff = 8035 + 2526 - 90
+        all_rows = []
+        for b in gen.snapshot_lineitem_batches(batch_orders=512, time=0):
+            df.step({"lineitem": b})
+            all_rows += b.to_rows()
+        for tick in range(3):
+            b = gen.churn_lineitem_batch(64, tick, time=df.time)
+            df.step({"lineitem": b})
+            all_rows += b.to_rows()
+
+        got = sorted(tuple(r[:-2]) for r in df.peek())
+        want = q1_oracle(all_rows, cutoff)
+        assert got == want
