@@ -1,0 +1,130 @@
+"""Exchange: route update rows to the worker that owns their key.
+
+The TPU analog of timely's Exchange pact with columnar containers
+(timely-util columnar_exchange, used by joins at
+compute/src/render/join/linear_join.rs:33-35 and arrangements at
+extensions/arrange.rs): every stateful operator's input is routed so the
+worker owning hash(key) % n_workers sees all updates for that key. On TPU
+the route is a `jax.lax.all_to_all` over the worker mesh axis inside the
+jitted SPMD step — the collective rides ICI, replacing the reference's
+zero-copy TCP mesh (SURVEY.md §2.5 plane 1).
+
+Fixed shapes: each sender packs rows into `n_shards` destination slots of
+`slot_cap` rows each ([P, S] buffers). A destination slot can overflow
+(skewed keys); the flag is returned so the host can retry the step at a
+larger slot tier — same scheme as arrangement capacity tiers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.lanes import hash_lanes, key_lanes
+from ..ops.sort import compact
+from ..repr.batch import Batch
+
+
+def shard_of(batch: Batch, key, num_shards: int) -> jnp.ndarray:
+    """Destination worker per row: hash of the key columns mod workers."""
+    lanes = key_lanes(batch, key)
+    h = hash_lanes(lanes)
+    return (h % jnp.uint64(num_shards)).astype(jnp.int32)
+
+
+def partition(batch: Batch, route: jnp.ndarray, num_shards: int,
+              slot_cap: int):
+    """Pack rows into a [num_shards * slot_cap] send buffer grouped by
+    destination (rows for shard d occupy [d*slot_cap, d*slot_cap+count_d)).
+
+    Returns (send_fields: dict, counts: [num_shards] int32, overflow: bool).
+    Rows beyond slot_cap for a destination are dropped and flagged.
+    """
+    cap = batch.capacity
+    valid = batch.valid_mask()
+    route = jnp.where(valid, route, num_shards)  # padding sorts last
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    # Stable sort by destination so each destination's rows are contiguous.
+    _, perm = jax.lax.sort(
+        [route, idx], num_keys=1, is_stable=True
+    )
+    sroute = route[perm]
+    # Rank within destination group.
+    starts = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), sroute[1:] != sroute[:-1]]
+    )
+    group_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(starts, idx, 0)
+    )
+    rank = idx - group_start
+    in_range = jnp.logical_and(sroute < num_shards, rank < slot_cap)
+    dest = jnp.where(
+        in_range, sroute * slot_cap + rank, num_shards * slot_cap
+    )
+    overflow = jnp.any(
+        jnp.logical_and(sroute < num_shards, rank >= slot_cap)
+    )
+    counts = jnp.minimum(
+        jnp.zeros(num_shards, dtype=jnp.int32)
+        .at[route]
+        .add(valid.astype(jnp.int32), mode="drop"),
+        slot_cap,
+    )
+
+    def scatter(a):
+        if a is None:
+            return None
+        out = jnp.zeros(num_shards * slot_cap, dtype=a.dtype)
+        return out.at[dest].set(a[perm], mode="drop")
+
+    fields = {
+        "cols": tuple(scatter(c) for c in batch.cols),
+        "nulls": tuple(scatter(n) for n in batch.nulls),
+        "time": scatter(batch.time),
+        "diff": scatter(batch.diff),
+    }
+    return fields, counts, overflow
+
+
+def exchange(batch: Batch, key, axis_name: str, num_shards: int,
+             slot_cap: int):
+    """Route rows to their key's owning worker. Must run inside shard_map
+    over `axis_name` with `num_shards` workers.
+
+    Returns (routed_batch, overflow). The routed batch has capacity
+    num_shards * slot_cap with valid rows compacted to the front.
+    """
+    route = shard_of(batch, key, num_shards)
+    fields, counts, overflow = partition(batch, route, num_shards, slot_cap)
+
+    def a2a(a):
+        if a is None:
+            return None
+        return jax.lax.all_to_all(
+            a.reshape(num_shards, slot_cap),
+            axis_name,
+            split_axis=0,
+            concat_axis=0,
+        ).reshape(num_shards * slot_cap)
+
+    recv_counts = jax.lax.all_to_all(
+        counts, axis_name, split_axis=0, concat_axis=0
+    )
+    # Row (p, i) of the receive buffer is valid iff i < recv_counts[p].
+    slot_idx = jnp.tile(
+        jnp.arange(slot_cap, dtype=jnp.int32), num_shards
+    )
+    keep = slot_idx < jnp.repeat(recv_counts, slot_cap)
+    out = Batch(
+        cols=tuple(a2a(c) for c in fields["cols"]),
+        nulls=tuple(a2a(n) for n in fields["nulls"]),
+        time=a2a(fields["time"]),
+        diff=a2a(fields["diff"]),
+        count=jnp.asarray(num_shards * slot_cap, dtype=jnp.int32),
+        schema=batch.schema,
+    )
+    out = compact(out, keep)
+    # Any sender overflowing means rows were dropped somewhere: all workers
+    # must retry together (the step is transactional).
+    overflow = jax.lax.psum(overflow.astype(jnp.int32), axis_name) > 0
+    return out, overflow

@@ -1,0 +1,42 @@
+"""Device meshes: the TPU analog of the timely worker cluster.
+
+In the reference a replica is `TimelyConfig.workers x len(addresses)` SPMD
+workers joined by a zero-copy TCP mesh (cluster-client/src/client.rs:19-25,
+cluster/src/communication.rs:100). Here a replica is a `jax.sharding.Mesh`
+over TPU devices joined by ICI: worker = device, exchange = all_to_all
+collectives inside one jitted SPMD step (SURVEY.md §2.4, §2.5).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+WORKER_AXIS = "workers"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = WORKER_AXIS) -> Mesh:
+    """A 1-D mesh of `n_devices` workers (default: all local devices).
+
+    One flat worker axis mirrors the reference's flat worker id space;
+    multi-host meshes extend this axis over DCN the way multi-process
+    replicas extend the timely mesh (communication.rs:100).
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def worker_sharding(mesh: Mesh, axis: str = WORKER_AXIS) -> NamedSharding:
+    """Sharding that splits leading-axis data across workers."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

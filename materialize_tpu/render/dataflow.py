@@ -9,14 +9,22 @@ rendering builds ONE pure function
 
 that XLA compiles once per capacity signature and the host calls per
 micro-batch (barrier-synchronous execution, SURVEY.md §7 design stance).
-Stateful operators (Reduce, and later Join/TopK/Threshold) own slots in
-the `states` tuple (Arrangements). Capacity overflow is detected on device
-and resolved host-side by growing the state tier and retrying the step —
-the compile-cache-per-capacity-tier scheme.
+Stateful operators (Reduce, Join, TopK, Threshold) own slots in the
+`states` tuple (Arrangements). Capacity overflow is detected on device
+and resolved host-side by growing the overflowed tier and retrying the
+step — the compile-cache-per-capacity-tier scheme.
 
-The ``Dataflow`` wrapper owns the host side: frontier/time advancement,
-jit caching, overflow retries, and the output arrangement serving peeks
-(the TraceManager + handle_peek analog, compute/src/compute_state.rs:744).
+Two execution modes share the same render walk:
+
+- ``Dataflow``: single device, no exchange (the one-worker replica).
+- ``ShardedDataflow``: SPMD over a worker mesh via ``shard_map``; every
+  stateful operator's input is routed to the key's owning worker with an
+  all_to_all exchange first (timely's Exchange pact, SURVEY.md §2.4) —
+  so each worker maintains a disjoint shard of every arrangement.
+
+The wrappers own the host side: frontier/time advancement, jit caching,
+overflow retries, and the output arrangement serving peeks (the
+TraceManager + handle_peek analog, compute/src/compute_state.rs:744).
 """
 
 from __future__ import annotations
@@ -26,14 +34,17 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from ..arrangement.spine import Arrangement, arrange, insert
 from ..expr import relation as mir
 from ..expr.linear import MapFilterProject, apply_mfp
 from ..ops.consolidate import consolidate
 from ..ops.reduce import ReduceAccumulable
+from ..parallel.exchange import exchange
+from ..parallel.mesh import WORKER_AXIS, worker_sharding
 from ..repr.batch import Batch, capacity_tier
-from ..repr.schema import Schema
+from ..repr.schema import DIFF_DTYPE, TIME_DTYPE, Schema
 
 
 def concat_batches(batches: list[Batch]) -> Batch:
@@ -87,12 +98,24 @@ class _StateSlot:
 
 class _RenderContext:
     """Collects state slots while walking the MIR tree (one walk at trace
-    time per compilation)."""
+    time per compilation). In sharded mode it also carries the mesh-axis
+    facts every exchange site needs."""
 
-    def __init__(self, source_schemas: dict):
+    def __init__(self, source_schemas: dict, num_shards: int = 1,
+                 axis_name: str = WORKER_AXIS, slot_cap: int = 256):
         self.source_schemas = source_schemas
         self.slots: list[_StateSlot] = []
         self.operators: list = []  # parallel to slots: op configs
+        self.num_shards = num_shards
+        self.axis_name = axis_name
+        # Per-destination send-slot capacity for exchanges; grown on
+        # overflow (mutated by the host wrapper, read at trace time).
+        self.slot_cap = slot_cap
+        self.n_exchanges = 0
+
+    @property
+    def sharded(self) -> bool:
+        return self.num_shards > 1
 
     def new_slot(self, op, init: Arrangement) -> int:
         idx = len(self.slots)
@@ -100,16 +123,77 @@ class _RenderContext:
         self.operators.append(op)
         return idx
 
+    def new_exchange_site(self) -> int:
+        idx = self.n_exchanges
+        self.n_exchanges += 1
+        return idx
+
+    def maybe_exchange(self, batch: Batch, key, site: int, ovf: dict):
+        """Route `batch` by `key` to owning workers (no-op single-shard)."""
+        if not self.sharded:
+            return batch, ovf
+        routed, overflow = exchange(
+            batch, key, self.axis_name, self.num_shards, self.slot_cap
+        )
+        ovf = dict(ovf)
+        ovf[("x", site)] = overflow
+        return routed, ovf
+
 
 def _build(expr: mir.RelationExpr, ctx: _RenderContext):
     """Returns a closure (states, inputs, time) -> (delta_batch,
-    state_updates: dict slot->new_state, overflow_flags: list)."""
+    state_updates: dict slot->new_state, overflow_flags: dict key->flag).
+
+    Overflow keys: ("state", slot) for arrangement tiers, ("x", site)
+    for exchange slot tiers.
+    """
 
     if isinstance(expr, mir.Get):
         name = expr.name
 
         def run(states, inputs, time):
-            return inputs[name], {}, []
+            return inputs[name], {}, {}
+
+        return run
+
+    if isinstance(expr, mir.Constant):
+        schema = expr._schema
+        rows = expr.rows
+
+        def run(states, inputs, time):
+            # Emit the constant collection exactly once: at time == 0
+            # (the as_of), nothing afterwards (render.rs:1170-1212).
+            n = len(rows)
+            cap = capacity_tier(max(n, 1))
+            cols = []
+            for j, c in enumerate(schema.columns):
+                vals = np.asarray(
+                    [r[0][j] for r in rows], dtype=c.dtype
+                ) if n else np.zeros(0, dtype=c.dtype)
+                pad = np.zeros(cap, dtype=c.dtype)
+                pad[:n] = vals
+                cols.append(jnp.asarray(pad))
+            diffs = np.zeros(cap, dtype=DIFF_DTYPE)
+            diffs[:n] = [r[1] for r in rows]
+            first = (time == 0).astype(jnp.int32)
+            if ctx.sharded:
+                # Exactly one worker emits the constant; the exchange in
+                # front of any stateful consumer routes rows to owners.
+                first = first * (
+                    jax.lax.axis_index(ctx.axis_name) == 0
+                ).astype(jnp.int32)
+            return (
+                Batch(
+                    cols=tuple(cols),
+                    nulls=tuple(None for _ in schema.columns),
+                    time=jnp.full(cap, time, dtype=TIME_DTYPE),
+                    diff=jnp.asarray(diffs),
+                    count=first * n,
+                    schema=schema,
+                ),
+                {},
+                {},
+            )
 
         return run
 
@@ -162,12 +246,12 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
         inners = [_build(i, ctx) for i in expr.inputs]
 
         def run(states, inputs, time):
-            parts, upd, ovf = [], {}, []
+            parts, upd, ovf = [], {}, {}
             for f in inners:
                 b, u, o = f(states, inputs, time)
                 parts.append(b)
                 upd.update(u)
-                ovf.extend(o)
+                ovf.update(o)
             return concat_batches(parts), upd, ovf
 
         return run
@@ -177,17 +261,22 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
             expr.input.schema(), expr.group_key, expr.aggregates
         )
         slot = ctx.new_slot(op, op.init_state())
+        site = ctx.new_exchange_site()
         inner = _build(expr.input, ctx)
+        group_key = expr.group_key
 
         def run(states, inputs, time):
             b, upd, ovf = inner(states, inputs, time)
+            b, ovf = ctx.maybe_exchange(b, group_key, site, ovf)
             state = states[slot]
             new_state, out, overflow = op.step(
                 state, b, time, state.capacity
             )
             upd = dict(upd)
             upd[slot] = new_state
-            return out, upd, ovf + [overflow]
+            ovf = dict(ovf)
+            ovf[("state", slot)] = overflow
+            return out, upd, ovf
 
         return run
 
@@ -196,12 +285,41 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
     )
 
 
-class Dataflow:
-    """A maintained dataflow: install once, feed update batches, peek.
+class _DataflowBase:
+    """Shared host-side machinery: output arrangement + peeks."""
+
+    def _init_output(self):
+        out_key = tuple(range(self.out_schema.arity))
+        self.output = Arrangement.empty(self.out_schema, out_key)
+        self._insert_jit = jax.jit(insert, static_argnames=("out_capacity",))
+
+    def _absorb_output(self, out: Batch):
+        """Merge an output delta into the output arrangement (the index
+        export: TraceManager arrangement, render.rs:357)."""
+        while True:
+            new_out, ovf = self._insert_jit(
+                self.output, out, out_capacity=self.output.capacity
+            )
+            if bool(ovf):
+                self.output = Arrangement(
+                    self.output.batch.with_capacity(self.output.capacity * 2),
+                    self.output.key,
+                )
+                continue
+            break
+        self.output = new_out
+
+    def peek(self) -> list[tuple]:
+        """Read the full maintained result (SELECT * FROM mv)."""
+        return self.output.batch.to_rows()
+
+
+class Dataflow(_DataflowBase):
+    """A maintained dataflow on one device: install once, feed update
+    batches, peek.
 
     The host-side analog of an installed DataflowDescription with an
-    index export (compute-types/src/dataflows.rs:32): output deltas are
-    merged into an output arrangement that serves peeks.
+    index export (compute-types/src/dataflows.rs:32).
     """
 
     def __init__(self, expr: mir.RelationExpr, name: str = "df"):
@@ -212,11 +330,9 @@ class Dataflow:
         self._run = _build(expr, ctx)
         self._ctx = ctx
         self.states = [s.init for s in ctx.slots]
-        out_key = tuple(range(self.out_schema.arity))
-        self.output = Arrangement.empty(self.out_schema, out_key)
+        self._init_output()
         self.time = 0  # frontier: all steps < time are complete
         self._step_jit = jax.jit(self._step_core)
-        self._insert_jit = jax.jit(insert, static_argnames=("out_capacity",))
 
     # pure, jitted once per capacity signature
     def _step_core(self, states, inputs, time):
@@ -235,40 +351,271 @@ class Dataflow:
             out, new_states, ovf = self._step_jit(
                 tuple(self.states), inputs, t
             )
-            if ovf and any(bool(o) for o in ovf):
-                # Grow every overflowed state to the next tier and retry;
-                # states were not committed, so the retry is idempotent.
-                grown = []
-                for s, o in zip(self.states, ovf):
-                    if bool(o):
-                        s = Arrangement(
-                            s.batch.with_capacity(s.batch.capacity * 2),
-                            s.key,
-                        )
-                    grown.append(s)
-                self.states = grown
+            grown = False
+            for (kind, idx), flag in ovf.items():
+                if kind == "state" and bool(flag):
+                    s = self.states[idx]
+                    self.states[idx] = Arrangement(
+                        s.batch.with_capacity(s.batch.capacity * 2), s.key
+                    )
+                    grown = True
+            if grown:
+                # States were not committed; the retry is idempotent.
                 continue
             break
         self.states = list(new_states)
-
-        # Maintain the output arrangement (index on the MV).
-        while True:
-            new_out, ovf = self._insert_jit(
-                self.output, out, out_capacity=self.output.capacity
-            )
-            if bool(ovf):
-                self.output = Arrangement(
-                    self.output.batch.with_capacity(
-                        self.output.capacity * 2
-                    ),
-                    self.output.key,
-                )
-                continue
-            break
-        self.output = new_out
+        self._absorb_output(out)
         self.time += 1
         return out
 
-    def peek(self) -> list[tuple]:
-        """Read the full maintained result (SELECT * FROM mv)."""
-        return self.output.batch.to_rows()
+
+def _shard_rows(arrays, n: int, num_shards: int, shard_cap: int):
+    """Deal host rows round-robin across shards; returns per-field
+    [num_shards * shard_cap] arrays + [num_shards] counts. Ingestion
+    balance only — exchange re-routes by key inside the step."""
+    base, extra = divmod(n, num_shards)
+    counts = np.full(num_shards, base, dtype=np.int32)
+    counts[:extra] += 1
+
+    def pack(a):
+        if a is None:
+            return None
+        out = np.zeros(num_shards * shard_cap, dtype=a.dtype)
+        for s in range(num_shards):
+            rows = a[s::num_shards]
+            out[s * shard_cap : s * shard_cap + len(rows)] = rows
+        return out
+
+    return [pack(a) for a in arrays], counts
+
+
+class ShardedDataflow(_DataflowBase):
+    """A maintained dataflow SPMD over a worker mesh.
+
+    Worker = device; every stateful operator's state is sharded by key
+    hash; inputs are dealt across workers and exchanged on key inside the
+    step (the timely model, SURVEY.md §2.4 row 1). One ``shard_map``-ped
+    jitted step per capacity signature.
+    """
+
+    def __init__(self, expr: mir.RelationExpr, mesh, name: str = "df",
+                 slot_cap: int = 256, input_shard_cap: int = 1024):
+        self.expr = expr
+        self.mesh = mesh
+        self.name = name
+        if len(mesh.axis_names) != 1:
+            raise ValueError(
+                "ShardedDataflow wants a 1-D worker mesh (make_mesh); "
+                f"got axes {mesh.axis_names}"
+            )
+        self.axis_name = mesh.axis_names[0]
+        self.num_shards = int(mesh.shape[self.axis_name])
+        self.out_schema = expr.schema()
+        ctx = _RenderContext(
+            {}, num_shards=self.num_shards, axis_name=self.axis_name,
+            slot_cap=slot_cap,
+        )
+        self._run = _build(expr, ctx)
+        self._ctx = ctx
+        self.input_shard_cap = input_shard_cap
+        self._sharding = worker_sharding(mesh, self.axis_name)
+        # Per-shard states, stored as global arrays [P * cap] / counts [P].
+        self.states = [
+            self._replicate_empty(s.init) for s in ctx.slots
+        ]
+        self._init_output()
+        self.time = 0
+        self._make_jit()
+
+    # -- sharded state layout ----------------------------------------------
+    def _replicate_empty(self, arr: Arrangement) -> Arrangement:
+        """Each worker starts with an empty shard of this arrangement."""
+        P_ = self.num_shards
+
+        def rep(a):
+            if a is None:
+                return None
+            return jax.device_put(
+                np.zeros(P_ * a.shape[0], dtype=a.dtype), self._sharding
+            )
+
+        b = arr.batch
+        gb = Batch(
+            cols=tuple(rep(c) for c in b.cols),
+            nulls=tuple(rep(n) for n in b.nulls),
+            time=rep(b.time),
+            diff=rep(b.diff),
+            count=jax.device_put(
+                np.zeros(P_, dtype=np.int32), self._sharding
+            ),
+            schema=b.schema,
+        )
+        return Arrangement(gb, arr.key)
+
+    def _grow_state(self, arr: Arrangement) -> Arrangement:
+        """Double every shard's capacity ([P, cap] -> [P, 2cap])."""
+        P_ = self.num_shards
+        b = arr.batch
+        cap = b.capacity // P_
+
+        def grow(a):
+            if a is None:
+                return None
+            h = np.asarray(a).reshape(P_, cap)
+            out = np.zeros((P_, 2 * cap), dtype=h.dtype)
+            out[:, :cap] = h
+            return jax.device_put(
+                out.reshape(P_ * 2 * cap), self._sharding
+            )
+
+        gb = Batch(
+            cols=tuple(grow(c) for c in b.cols),
+            nulls=tuple(grow(n) for n in b.nulls),
+            time=grow(b.time),
+            diff=grow(b.diff),
+            count=b.count,
+            schema=b.schema,
+        )
+        return Arrangement(gb, arr.key)
+
+    # -- the SPMD step ------------------------------------------------------
+    def _make_jit(self):
+        axis = self.axis_name
+
+        def per_worker(states, inputs, time):
+            # Leaves arrive rank-preserved: counts are [1]; make scalar.
+            states = [
+                Arrangement(
+                    s.batch.replace(count=s.batch.count.reshape(())), s.key
+                )
+                for s in states
+            ]
+            inputs = {
+                k: b.replace(count=b.count.reshape(()))
+                for k, b in inputs.items()
+            }
+            out, upd, ovf = self._run(states, inputs, time)
+            out = consolidate(out)
+            new_states = list(states)
+            for k, v in upd.items():
+                new_states[k] = v
+            # Rank-1 everything for the shard_map boundary.
+            out = out.replace(count=out.count.reshape((1,)))
+            new_states = tuple(
+                Arrangement(
+                    s.batch.replace(count=s.batch.count.reshape((1,))),
+                    s.key,
+                )
+                for s in new_states
+            )
+            # Overflow anywhere aborts the step on every worker.
+            ovf = {
+                k: (jax.lax.psum(v.astype(jnp.int32), axis) > 0).reshape(
+                    (1,)
+                )
+                for k, v in ovf.items()
+            }
+            return out, new_states, ovf
+
+        def step(states, inputs, time):
+            return jax.shard_map(
+                per_worker,
+                mesh=self.mesh,
+                in_specs=(P(self.axis_name), P(self.axis_name), P()),
+                out_specs=(P(self.axis_name), P(self.axis_name),
+                           P(self.axis_name)),
+                check_vma=False,
+            )(states, inputs, time)
+
+        self._step_jit = jax.jit(step)
+
+    def _pack_inputs(self, inputs: dict) -> dict:
+        packed = {}
+        for name, b in inputs.items():
+            if isinstance(b, Batch) and b.count.ndim == 0:
+                # Host-global batch: deal rows across workers.
+                n = int(b.count)
+                cols = [np.asarray(c)[:n] for c in b.cols]
+                nulls = [
+                    None if nl is None else np.asarray(nl)[:n]
+                    for nl in b.nulls
+                ]
+                time = np.asarray(b.time)[:n]
+                diff = np.asarray(b.diff)[:n]
+                cap = self.input_shard_cap
+                while cap * self.num_shards < n or capacity_tier(
+                    max((n + self.num_shards - 1) // self.num_shards, 1)
+                ) > cap:
+                    cap *= 2
+                fields, counts = _shard_rows(
+                    cols + nulls + [time, diff], n, self.num_shards, cap
+                )
+                k = len(cols)
+                put = lambda a: (
+                    None
+                    if a is None
+                    else jax.device_put(a, self._sharding)
+                )
+                packed[name] = Batch(
+                    cols=tuple(put(a) for a in fields[:k]),
+                    nulls=tuple(put(a) for a in fields[k : 2 * k]),
+                    time=put(fields[2 * k]),
+                    diff=put(fields[2 * k + 1]),
+                    count=jax.device_put(counts, self._sharding),
+                    schema=b.schema,
+                )
+            else:
+                packed[name] = b
+        return packed
+
+    def _gather_output(self, out: Batch) -> Batch:
+        """Concatenate every worker's output delta into one host batch."""
+        P_ = self.num_shards
+        counts = np.asarray(out.count)
+        cap = out.diff.shape[0] // P_
+        sel = np.concatenate(
+            [
+                np.arange(p * cap, p * cap + counts[p])
+                for p in range(P_)
+            ]
+        ).astype(np.int64) if counts.sum() else np.zeros(0, dtype=np.int64)
+        cols = [np.asarray(c)[sel] for c in out.cols]
+        nulls = [
+            None if nl is None else np.asarray(nl)[sel] for nl in out.nulls
+        ]
+        return Batch.from_numpy(
+            out.schema,
+            cols,
+            np.asarray(out.time)[sel],
+            np.asarray(out.diff)[sel],
+            nulls=nulls,
+        )
+
+    def step(self, inputs: dict) -> Batch:
+        """Feed one micro-batch (host batches are dealt across workers);
+        returns the gathered output delta and advances the frontier."""
+        t = jnp.asarray(self.time, dtype=jnp.uint64)
+        packed = self._pack_inputs(inputs)
+        while True:
+            out, new_states, ovf = self._step_jit(
+                tuple(self.states), packed, t
+            )
+            grown = False
+            for (kind, idx), flag in ovf.items():
+                if not bool(np.any(np.asarray(flag))):
+                    continue
+                if kind == "state":
+                    self.states[idx] = self._grow_state(self.states[idx])
+                    grown = True
+                elif kind == "x":
+                    self._ctx.slot_cap *= 2
+                    self._make_jit()
+                    grown = True
+            if grown:
+                continue
+            break
+        self.states = list(new_states)
+        host_out = self._gather_output(out)
+        self._absorb_output(host_out)
+        self.time += 1
+        return host_out
