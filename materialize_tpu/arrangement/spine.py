@@ -74,14 +74,20 @@ class Arrangement:
 
 def arrange(batch: Batch, key, capacity: int | None = None) -> Arrangement:
     """Sort+consolidate a batch into an Arrangement (build from scratch)."""
-    arr = Arrangement(batch, tuple(key))
+    key = tuple(key)
     cons = consolidate(batch, include_time=False)
-    arr = Arrangement(cons, tuple(key))
-    perm = sort_perm(arr.sort_lanes(), cons.count, cons.capacity)
-    sorted_batch = apply_perm(cons, perm)
+    if key == tuple(range(len(key))):
+        # Key is a schema prefix: consolidate's full-row sort order
+        # (schema order) IS the arrangement order — skip the re-sort
+        # (sort compiles are the TPU cost center).
+        sorted_batch = cons
+    else:
+        arr = Arrangement(cons, key)
+        perm = sort_perm(arr.sort_lanes(), cons.count, cons.capacity)
+        sorted_batch = apply_perm(cons, perm)
     if capacity is not None and capacity != sorted_batch.capacity:
         sorted_batch = sorted_batch.with_capacity(capacity)
-    return Arrangement(sorted_batch, tuple(key))
+    return Arrangement(sorted_batch, key)
 
 
 def insert(
@@ -105,6 +111,8 @@ def insert(
     # consolidate sums their diffs. Sort order is preserved by
     # consolidate's stable full-row sort.
     cons = consolidate(merged, include_time=False)
+    if arr.key == tuple(range(len(arr.key))):
+        return Arrangement(cons, arr.key), overflow
     out = Arrangement(cons, arr.key)
     perm = sort_perm(out.sort_lanes(), cons.count, cons.capacity)
     return Arrangement(apply_perm(cons, perm), arr.key), overflow

@@ -1,0 +1,204 @@
+"""Linear (binary, arranged) incremental join.
+
+Analog of the reference's linear join rendering
+(compute/src/render/join/linear_join.rs:204, work loop
+render/join/mz_join_core.rs:574-600): each stage keeps both sides
+arranged by the join key and emits, per update batch,
+
+    d(A ⋈ B) = dA ⋈ B_old  +  A_new ⋈ dB        (A_new = A_old + dA)
+
+which counts every new-new pair exactly once. Where the reference
+merge-joins new batches against trace cursors with yield fuel, the TPU
+version is a fixed-shape two-pass probe: binary-search each delta row's
+match range in the other side's sorted arrangement, size the output with
+a cumulative sum, then expand (gather) into a fixed-capacity output tier
+— overflow retries at a larger tier (SURVEY.md §7 hard part #1).
+
+SQL semantics: NULL join keys match nothing (NULL != NULL), so null-key
+rows are dropped from both state and probes; the state schemas normalize
+key columns to non-nullable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..arrangement.spine import Arrangement, insert, lookup_range
+from ..ops.lanes import key_lanes
+from ..ops.sort import concat_batches
+from ..repr.batch import Batch
+from ..repr.schema import Column, Schema
+
+
+def expand_ranges(lo, hi, valid, out_capacity: int):
+    """Flatten per-probe match ranges [lo, hi) into (probe_idx, match_pos)
+    pairs occupying a contiguous prefix of length total = sum(hi - lo).
+
+    Returns (probe_idx, match_pos, out_valid, overflow); positions beyond
+    `total` are clamped garbage masked by out_valid.
+    """
+    n = lo.shape[0]
+    sizes = jnp.where(valid, (hi - lo).astype(jnp.int64), 0)
+    csum = jnp.cumsum(sizes)  # inclusive
+    offs = csum - sizes  # exclusive
+    total = csum[-1] if n else jnp.asarray(0, jnp.int64)
+    j = jnp.arange(out_capacity, dtype=jnp.int64)
+    probe = jnp.searchsorted(csum, j, side="right").astype(jnp.int32)
+    probe_c = jnp.clip(probe, 0, max(n - 1, 0))
+    match = lo[probe_c] + (j - offs[probe_c]).astype(jnp.int32)
+    out_valid = j < jnp.minimum(total, out_capacity)
+    overflow = total > out_capacity
+    return probe_c, match, out_valid, overflow
+
+
+def null_key_diffs(batch: Batch, key) -> jnp.ndarray:
+    """Diff column with NULL-key rows zeroed (they never join)."""
+    d = batch.diff
+    for i in key:
+        nl = batch.nulls[i]
+        if nl is not None:
+            d = jnp.where(nl, 0, d)
+    return d
+
+
+@dataclass
+class JoinOp:
+    """One binary linear-join stage. State: (left, right) arrangements
+    keyed by the join key columns. Output schema: left cols ++ right cols
+    (MIR Join concatenates inputs; relation.rs Join)."""
+
+    left_schema: Schema
+    right_schema: Schema
+    left_key: tuple
+    right_key: tuple
+
+    def __post_init__(self):
+        assert len(self.left_key) == len(self.right_key)
+
+        def state_schema(schema: Schema, key) -> Schema:
+            # Key columns normalized non-nullable (null keys are dropped)
+            # so both sides' key lanes encode identically.
+            cols = []
+            for i, c in enumerate(schema.columns):
+                if i in key:
+                    cols.append(Column(c.name, c.ctype, False, c.scale))
+                else:
+                    cols.append(c)
+            return Schema(cols)
+
+        self.left_state_schema = state_schema(self.left_schema, self.left_key)
+        self.right_state_schema = state_schema(
+            self.right_schema, self.right_key
+        )
+        lk = self.left_state_schema
+        rk = self.right_state_schema
+        for li, ri in zip(self.left_key, self.right_key):
+            if lk[li].ctype is not rk[ri].ctype:
+                raise TypeError(
+                    f"join key type mismatch: {lk[li]} vs {rk[ri]}"
+                )
+        self.out_schema = Schema(
+            tuple(self.left_schema.columns) + tuple(self.right_schema.columns)
+        )
+        self.n_parts = 2
+
+    def init_state(self, capacity: int = 256) -> tuple:
+        return (
+            Arrangement.empty(
+                self.left_state_schema, self.left_key, capacity
+            ),
+            Arrangement.empty(
+                self.right_state_schema, self.right_key, capacity
+            ),
+        )
+
+    def _clean(self, delta: Batch, key, schema: Schema) -> Batch:
+        """Zero null-key rows and rewrap with the state schema."""
+        return delta.replace(
+            diff=null_key_diffs(delta, key), schema=schema
+        )
+
+    def _probe(
+        self,
+        arr: Arrangement,
+        delta: Batch,
+        delta_key,
+        delta_is_left: bool,
+        out_time,
+        out_capacity: int,
+    ):
+        """delta ⋈ arr (matching rows expanded), output in out_schema
+        column order."""
+        probe_lanes = key_lanes(delta, delta_key)
+        lo, hi = lookup_range(arr, probe_lanes)
+        valid = jnp.logical_and(delta.valid_mask(), delta.diff != 0)
+        probe_idx, match, out_valid, overflow = expand_ranges(
+            lo, hi, valid, out_capacity
+        )
+
+        def g_delta(a):
+            return None if a is None else a[probe_idx]
+
+        def g_arr(a):
+            return None if a is None else a[match]
+
+        d_cols = [g_delta(c) for c in delta.cols]
+        d_nulls = [g_delta(n) for n in delta.nulls]
+        a_cols = [g_arr(c) for c in arr.batch.cols]
+        a_nulls = [g_arr(n) for n in arr.batch.nulls]
+        if delta_is_left:
+            cols, nulls = d_cols + a_cols, d_nulls + a_nulls
+        else:
+            cols, nulls = a_cols + d_cols, a_nulls + d_nulls
+        diff = jnp.where(
+            out_valid, delta.diff[probe_idx] * arr.batch.diff[match], 0
+        )
+        count = jnp.sum(out_valid.astype(jnp.int32))
+        return (
+            Batch(
+                cols=tuple(cols),
+                nulls=tuple(nulls),
+                time=jnp.full(out_capacity, out_time, dtype=jnp.uint64),
+                diff=diff,
+                count=count,
+                schema=self.out_schema,
+            ),
+            overflow,
+        )
+
+    def step(
+        self,
+        state: tuple,
+        d_left: Batch,
+        d_right: Batch,
+        out_time,
+        out_capacity: int,
+    ):
+        """Returns (new_state, out_delta, state_overflow: dict part->flag,
+        join_overflow)."""
+        A, B = state
+        dl = self._clean(d_left, self.left_key, self.left_state_schema)
+        dr = self._clean(d_right, self.right_key, self.right_state_schema)
+
+        overflow = {}
+        new_A, overflow[0] = insert(A, dl, A.capacity)
+        new_B, overflow[1] = insert(B, dr, B.capacity)
+
+        # dA ⋈ B_old
+        out1, ovf1 = self._probe(
+            B, dl, self.left_key, True, out_time, out_capacity
+        )
+        # A_new ⋈ dB (includes dA ⋈ dB exactly once)
+        out2, ovf2 = self._probe(
+            new_A, dr, self.right_key, False, out_time, out_capacity
+        )
+
+        # No consolidation: out1/out2 produce each pair exactly once, and
+        # multiset semantics tolerate duplicate row values with separate
+        # diffs (downstream arrangement inserts consolidate). Skipping it
+        # avoids a 2x-join-capacity sort.
+        out = concat_batches([out1, out2])
+        join_overflow = jnp.logical_or(ovf1, ovf2)
+        return (new_A, new_B), out, overflow, join_overflow

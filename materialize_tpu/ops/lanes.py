@@ -148,6 +148,10 @@ def key_lanes(batch: Batch, key_indices) -> list[jnp.ndarray]:
                 )
         else:
             lanes.extend(val_lanes)
+    if not lanes:
+        # Empty key (global aggregate): every row is one group. A single
+        # constant lane keeps the lane-tuple machinery uniform.
+        lanes.append(jnp.zeros(batch.capacity, dtype=jnp.uint64))
     return lanes
 
 

@@ -27,7 +27,7 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
-from ..arrangement.spine import Arrangement, lookup_range
+from ..arrangement.spine import Arrangement, insert, lookup_range
 from ..expr.relation import AggregateExpr, AggregateFunc
 from ..expr.scalar import eval_expr
 from ..ops.consolidate import consolidate
@@ -171,18 +171,20 @@ def sum_by_key(batch: Batch, n_key: int) -> Batch:
 
 
 def merge_accum_state(
-    state: Arrangement, accum_delta: Batch, out_capacity: int
+    state: Arrangement, groups: Batch, out_capacity: int
 ):
     """Merge per-group accumulator deltas into the state arrangement,
     summing accum columns on key collision and dropping dead groups
-    (row_count == 0)."""
+    (row_count == 0). `groups` must already be key-sorted and key-unique
+    (the output of sum_by_key) — this keeps the merge free of
+    input-capacity-sized sorts (TPU sort compile time is superlinear in
+    rows; see materialize_tpu/__init__.py)."""
     n_key = len(state.key)
-    d_sorted = sum_by_key(accum_delta, n_key)
     merged, overflow = merge_sorted(
         state.batch,
         key_lanes(state.batch, range(n_key)),
-        d_sorted,
-        key_lanes(d_sorted, range(n_key)),
+        groups,
+        key_lanes(groups, range(n_key)),
         out_capacity,
     )
     summed = sum_by_key(merged, n_key)
@@ -248,73 +250,217 @@ def accums_to_output(
     return cols, nulls
 
 
+def minmax_state_schema(
+    input_schema: Schema, group_key, agg: AggregateExpr
+) -> Schema:
+    """State schema for one hierarchical (min/max) aggregate: the sorted
+    multiset of (group key, non-NULL aggregate input value)."""
+    cols = [input_schema[i] for i in group_key]
+    inner = agg.expr.typ(input_schema)
+    # NULL inputs are filtered out of this state (SQL min/max skip NULLs);
+    # the column is therefore non-nullable, keeping lane arity minimal.
+    cols.append(Column("__v__", inner.ctype, False, inner.scale))
+    return Schema(cols)
+
+
+def minmax_contributions(
+    batch: Batch, group_key, agg: AggregateExpr, state_schema: Schema
+) -> Batch:
+    """Project an input delta batch to (key..., value) multiset updates,
+    dropping NULL values (min/max ignore them)."""
+    cols = [batch.cols[i] for i in group_key]
+    nulls = [batch.nulls[i] for i in group_key]
+    ev = eval_expr(agg.expr, batch)
+    vcol = state_schema[len(group_key)]
+    cols.append(ev.values.astype(vcol.dtype))
+    nulls.append(None)
+    keep = jnp.logical_not(ev.null_mask())
+    out = Batch(
+        cols=tuple(cols),
+        nulls=tuple(nulls),
+        time=batch.time,
+        diff=jnp.where(keep, batch.diff, 0),
+        count=batch.count,
+        schema=state_schema,
+    )
+    # Rows with diff 0 (NULL value or padding) vanish in consolidation
+    # during the arrangement insert.
+    return out
+
+
+def minmax_query(state: Arrangement, probe_lanes, is_max: bool):
+    """Current min (or max) value per probe group from the sorted state.
+
+    The arrangement is sorted by (key, value), so the group minimum is
+    the first row of the group's range and the maximum the last — the
+    whole point of keeping a sorted multiset instead of the reference's
+    16-ary tournament tree (render/reduce.rs:850): retraction repair is
+    a binary search, not a tree rebuild.
+
+    Returns (values, absent): absent=True where the group has no non-NULL
+    values (SQL result NULL)."""
+    lo, hi = lookup_range(state, probe_lanes)
+    found = hi > lo
+    idx = jnp.where(is_max, hi - 1, lo)
+    idx = jnp.clip(idx, 0, max(state.capacity - 1, 0))
+    n_key = len(state.key)
+    vals = state.batch.cols[n_key][idx]
+    return jnp.where(found, vals, jnp.zeros_like(vals)), jnp.logical_not(
+        found
+    )
+
+
 @dataclass
-class ReduceAccumulable:
-    """Static config for one accumulable reduce operator."""
+class ReduceOp:
+    """A full collated Reduce: accumulable aggregates fold into per-group
+    accumulators; hierarchical (min/max) aggregates keep a sorted
+    (key, value) multiset per aggregate expression. Analog of
+    ``ReducePlan::Collation`` over Accumulable + Hierarchical plans
+    (compute-types/src/plan/reduce.rs:130; render/reduce.rs build_collation).
+
+    State is a tuple of Arrangements: part 0 the accumulator state
+    (always present — its ``__rows__`` column is the group-liveness
+    authority), parts 1.. one per hierarchical aggregate.
+    """
 
     input_schema: Schema
     group_key: tuple
     aggregates: tuple
 
     def __post_init__(self):
+        self.n_key = len(self.group_key)
+        unsupported = [
+            a.func
+            for a in self.aggregates
+            if not (a.func.is_accumulable or a.func.is_hierarchical)
+        ]
+        if unsupported:
+            raise NotImplementedError(f"aggregates {unsupported}")
+        self.acc_aggs = tuple(
+            (j, a)
+            for j, a in enumerate(self.aggregates)
+            if a.func.is_accumulable
+        )
+        self.hier_aggs = tuple(
+            (j, a)
+            for j, a in enumerate(self.aggregates)
+            if a.func.is_hierarchical
+        )
         self.state_schema = accum_schema(
-            self.input_schema, self.group_key, self.aggregates
+            self.input_schema,
+            self.group_key,
+            tuple(a for _, a in self.acc_aggs),
+        )
+        self.mm_schemas = tuple(
+            minmax_state_schema(self.input_schema, self.group_key, a)
+            for _, a in self.hier_aggs
         )
         self.out_schema = output_schema(
             self.input_schema, self.group_key, self.aggregates
         )
-        self.n_key = len(self.group_key)
+        self.n_parts = 1 + len(self.hier_aggs)
 
-    def init_state(self, capacity: int = 256) -> Arrangement:
-        return Arrangement.empty(
-            self.state_schema, tuple(range(self.n_key)), capacity
-        )
+    def init_state(self, capacity: int = 256) -> tuple:
+        key = tuple(range(self.n_key))
+        parts = [Arrangement.empty(self.state_schema, key, capacity)]
+        for sch in self.mm_schemas:
+            parts.append(Arrangement.empty(sch, key, capacity))
+        return tuple(parts)
 
-    def step(
-        self,
-        state: Arrangement,
-        delta: Batch,
-        out_time,
-        state_capacity: int,
-    ):
+    def step(self, state: tuple, delta: Batch, out_time):
         """Process one delta batch.
 
-        Returns (new_state, output_delta_batch, state_overflow).
-        Output capacity = 2 * delta capacity (retraction + insertion per
-        touched group, and touched groups <= delta rows).
+        Returns (new_state, output_delta_batch, overflow: dict part->flag).
         """
+        acc_state = state[0]
+        acc_aggs = tuple(a for _, a in self.acc_aggs)
         contrib = delta_contributions(
-            delta, self.group_key, self.aggregates, self.state_schema
+            delta, self.group_key, acc_aggs, self.state_schema
         )
         groups = sum_by_key(contrib, self.n_key)  # one row per touched group
         gcap = groups.capacity
         gvalid = groups.valid_mask()
 
-        old_accums, _found = gather_old_accums(state, groups)
+        old_accums, _found = gather_old_accums(acc_state, groups)
         new_accums = [
             o + d for o, d in zip(old_accums, groups.cols[self.n_key:])
         ]
         old_alive = jnp.logical_and(gvalid, old_accums[0] > 0)
         new_alive = jnp.logical_and(gvalid, new_accums[0] > 0)
 
+        overflow = {}
+        new_state_acc, overflow[0] = merge_accum_state(
+            acc_state, groups, acc_state.capacity
+        )
+
+        # Hierarchical parts: query before and after the multiset merge.
+        probe_lanes = key_lanes(groups, range(self.n_key))
+        mm_old, mm_new, new_mm_states = [], [], []
+        for p, ((j, agg), sch) in enumerate(
+            zip(self.hier_aggs, self.mm_schemas), start=1
+        ):
+            mm_state = state[p]
+            is_max = agg.func is AggregateFunc.MAX
+            mm_old.append(minmax_query(mm_state, probe_lanes, is_max))
+            mm_contrib = minmax_contributions(
+                delta, self.group_key, agg, sch
+            )
+            new_mm, overflow[p] = insert(
+                mm_state, mm_contrib, mm_state.capacity
+            )
+            mm_new.append(minmax_query(new_mm, probe_lanes, is_max))
+            new_mm_states.append(new_mm)
+
+        # Assemble old/new output rows over ALL aggregates in order.
         key_cols = groups.cols[: self.n_key]
         key_nulls = groups.nulls[: self.n_key]
-        time_col = jnp.full(gcap, out_time, dtype=jnp.uint64)
 
-        old_cols, old_nulls = accums_to_output(
-            key_cols, key_nulls, old_accums, self.aggregates,
-            self.input_schema, self.out_schema, out_time, old_alive, gcap,
-        )
-        new_cols, new_nulls = accums_to_output(
-            key_cols, key_nulls, new_accums, self.aggregates,
-            self.input_schema, self.out_schema, out_time, new_alive, gcap,
-        )
+        def assemble(accums, mm_vals):
+            acc_cols, acc_nulls = accums_to_output(
+                key_cols, key_nulls, accums, acc_aggs,
+                self.input_schema, self.out_schema, out_time, None, gcap,
+            )
+            cols = list(acc_cols[: self.n_key])
+            nulls = list(acc_nulls[: self.n_key])
+            acc_i = self.n_key
+            mm_i = 0
+            for j, agg in enumerate(self.aggregates):
+                if agg.func.is_accumulable:
+                    cols.append(acc_cols[acc_i])
+                    nulls.append(acc_nulls[acc_i])
+                    acc_i += 1
+                else:
+                    vals, absent = mm_vals[mm_i]
+                    cols.append(vals)
+                    nulls.append(absent)
+                    mm_i += 1
+            return cols, nulls
+
+        old_cols, old_nulls = assemble(old_accums, mm_old)
+        new_cols, new_nulls = assemble(new_accums, mm_new)
+
+        # Old and new rows are ALIGNED per group, so "output unchanged"
+        # is a columnwise comparison — no consolidation sort needed
+        # (the reference gets the same effect from consolidation; we
+        # avoid the sort because TPU sort compiles are the cost center).
+        changed = old_alive != new_alive
+        for oc, nc, on, nn in zip(
+            old_cols[self.n_key:], new_cols[self.n_key:],
+            old_nulls[self.n_key:], new_nulls[self.n_key:],
+        ):
+            z = jnp.zeros(gcap, dtype=bool)
+            on_m = on if on is not None else z
+            nn_m = nn if nn is not None else z
+            col_differs = jnp.logical_or(
+                on_m != nn_m,
+                jnp.logical_and(jnp.logical_not(on_m), oc != nc),
+            )
+            changed = jnp.logical_or(changed, col_differs)
 
         def halves(olds, news):
             return jnp.concatenate([olds, news])
 
-        out_cols = []
-        out_nulls = []
+        out_cols, out_nulls = [], []
         for oc, nc in zip(old_cols, new_cols):
             out_cols.append(halves(oc, nc))
         for on, nn in zip(old_nulls, new_nulls):
@@ -327,9 +473,14 @@ class ReduceAccumulable:
                            nn if nn is not None else z)
                 )
         out_diff = halves(
-            jnp.where(old_alive, -1, 0).astype(jnp.int64),
-            jnp.where(new_alive, 1, 0).astype(jnp.int64),
+            jnp.where(jnp.logical_and(old_alive, changed), -1, 0).astype(
+                jnp.int64
+            ),
+            jnp.where(jnp.logical_and(new_alive, changed), 1, 0).astype(
+                jnp.int64
+            ),
         )
+        time_col = jnp.full(gcap, out_time, dtype=jnp.uint64)
         keep = out_diff != 0
         out = Batch(
             cols=tuple(out_cols),
@@ -340,9 +491,5 @@ class ReduceAccumulable:
             schema=self.out_schema,
         )
         out = compact(out, keep)
-        # Identical retract+insert pairs (group's output unchanged — e.g.
-        # updates that cancel) are removed by consolidation.
-        out = consolidate(out)
 
-        new_state, overflow = merge_accum_state(state, groups, state_capacity)
-        return new_state, out, overflow
+        return tuple([new_state_acc] + new_mm_states), out, overflow

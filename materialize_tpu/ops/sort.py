@@ -71,6 +71,75 @@ def compact(batch: Batch, keep: jnp.ndarray) -> Batch:
     )
 
 
+def concat_batches(batches: list[Batch]) -> Batch:
+    """Concatenate batches of the same schema (capacity = sum of caps).
+    Valid rows are NOT contiguous across parts, so this compacts."""
+    assert batches
+    if len(batches) == 1:
+        return batches[0]
+    schema = batches[0].schema
+    cap = sum(b.capacity for b in batches)
+
+    def cat(field):
+        parts = [field(b) for b in batches]
+        if any(p is None for p in parts):
+            parts = [
+                p
+                if p is not None
+                else jnp.zeros(b.capacity, dtype=bool)
+                for p, b in zip(parts, batches)
+            ]
+        return jnp.concatenate(parts)
+
+    keep = jnp.concatenate([b.valid_mask() for b in batches])
+    out = Batch(
+        cols=tuple(
+            cat(lambda b, i=i: b.cols[i]) for i in range(schema.arity)
+        ),
+        nulls=tuple(
+            (
+                None
+                if all(b.nulls[i] is None for b in batches)
+                else cat(lambda b, i=i: b.nulls[i])
+            )
+            for i in range(schema.arity)
+        ),
+        time=cat(lambda b: b.time),
+        diff=cat(lambda b: b.diff),
+        count=jnp.asarray(cap, dtype=jnp.int32),
+        schema=schema,
+    )
+    return compact(out, keep)
+
+
+def shrink(batch: Batch, capacity: int):
+    """Slice a batch down to a smaller capacity tier. Valid rows are
+    always a contiguous prefix (every producer compacts), so this is a
+    free static slice — no data movement. Returns (batch, overflow);
+    on overflow (count > capacity) the tail was dropped and the host
+    must retry at a larger tier.
+
+    Used to decouple a consumer's compile-time capacity from a
+    producer's: output deltas are few rows in large-capacity batches,
+    and downstream sorts compile per capacity (superlinearly — see
+    materialize_tpu/__init__.py)."""
+    if capacity >= batch.capacity:
+        return batch, jnp.asarray(False)
+
+    def sl(a):
+        return None if a is None else a[:capacity]
+
+    out = Batch(
+        cols=tuple(sl(c) for c in batch.cols),
+        nulls=tuple(sl(n) for n in batch.nulls),
+        time=sl(batch.time),
+        diff=sl(batch.diff),
+        count=jnp.minimum(batch.count, capacity),
+        schema=batch.schema,
+    )
+    return out, batch.count > capacity
+
+
 def segment_starts(lanes, count, capacity: int) -> jnp.ndarray:
     """Given rows already sorted by `lanes`, a bool mask marking the first
     row of each run of equal keys (padding rows excluded)."""

@@ -20,14 +20,30 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..ops.lanes import hash_lanes, key_lanes
+from ..ops.lanes import column_lanes, hash_lanes, key_lanes
 from ..ops.sort import compact
 from ..repr.batch import Batch
 
 
-def shard_of(batch: Batch, key, num_shards: int) -> jnp.ndarray:
-    """Destination worker per row: hash of the key columns mod workers."""
-    lanes = key_lanes(batch, key)
+def shard_of(
+    batch: Batch, key, num_shards: int, null_aware: bool = True
+) -> jnp.ndarray:
+    """Destination worker per row: hash of the key columns mod workers.
+
+    null_aware=False hashes raw value lanes only (no null lanes) so both
+    sides of a join route equal keys identically even when their key
+    columns differ in nullability; join semantics drop NULL keys anyway.
+    """
+    if null_aware:
+        lanes = key_lanes(batch, key)
+    else:
+        lanes = []
+        for i in key:
+            lanes.extend(
+                column_lanes(batch.cols[i], batch.schema[i].ctype)
+            )
+        if not lanes:
+            lanes = [jnp.zeros(batch.capacity, dtype=jnp.uint64)]
     h = hash_lanes(lanes)
     return (h % jnp.uint64(num_shards)).astype(jnp.int32)
 
@@ -87,14 +103,14 @@ def partition(batch: Batch, route: jnp.ndarray, num_shards: int,
 
 
 def exchange(batch: Batch, key, axis_name: str, num_shards: int,
-             slot_cap: int):
+             slot_cap: int, null_aware: bool = True):
     """Route rows to their key's owning worker. Must run inside shard_map
     over `axis_name` with `num_shards` workers.
 
     Returns (routed_batch, overflow). The routed batch has capacity
     num_shards * slot_cap with valid rows compacted to the front.
     """
-    route = shard_of(batch, key, num_shards)
+    route = shard_of(batch, key, num_shards, null_aware)
     fields, counts, overflow = partition(batch, route, num_shards, slot_cap)
 
     def a2a(a):

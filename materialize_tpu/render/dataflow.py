@@ -40,54 +40,13 @@ from ..arrangement.spine import Arrangement, arrange, insert
 from ..expr import relation as mir
 from ..expr.linear import MapFilterProject, apply_mfp
 from ..ops.consolidate import consolidate
-from ..ops.reduce import ReduceAccumulable
+from ..ops.join import JoinOp
+from ..ops.reduce import ReduceOp
+from ..ops.sort import concat_batches, shrink
 from ..parallel.exchange import exchange
 from ..parallel.mesh import WORKER_AXIS, worker_sharding
 from ..repr.batch import Batch, capacity_tier
 from ..repr.schema import DIFF_DTYPE, TIME_DTYPE, Schema
-
-
-def concat_batches(batches: list[Batch]) -> Batch:
-    """Concatenate batches of the same schema (capacity = sum of caps).
-    Valid rows are NOT contiguous across parts, so this compacts."""
-    assert batches
-    if len(batches) == 1:
-        return batches[0]
-    schema = batches[0].schema
-    cap = sum(b.capacity for b in batches)
-
-    def cat(field):
-        parts = [field(b) for b in batches]
-        if any(p is None for p in parts):
-            parts = [
-                p
-                if p is not None
-                else jnp.zeros(b.capacity, dtype=bool)
-                for p, b in zip(parts, batches)
-            ]
-        return jnp.concatenate(parts)
-
-    keep = jnp.concatenate([b.valid_mask() for b in batches])
-    out = Batch(
-        cols=tuple(
-            cat(lambda b, i=i: b.cols[i]) for i in range(schema.arity)
-        ),
-        nulls=tuple(
-            (
-                None
-                if all(b.nulls[i] is None for b in batches)
-                else cat(lambda b, i=i: b.nulls[i])
-            )
-            for i in range(schema.arity)
-        ),
-        time=cat(lambda b: b.time),
-        diff=cat(lambda b: b.diff),
-        count=jnp.asarray(cap, dtype=jnp.int32),
-        schema=schema,
-    )
-    from ..ops.sort import compact
-
-    return compact(out, keep)
 
 
 @dataclass
@@ -102,7 +61,8 @@ class _RenderContext:
     facts every exchange site needs."""
 
     def __init__(self, source_schemas: dict, num_shards: int = 1,
-                 axis_name: str = WORKER_AXIS, slot_cap: int = 256):
+                 axis_name: str = WORKER_AXIS, slot_cap: int = 256,
+                 join_cap: int = 1024):
         self.source_schemas = source_schemas
         self.slots: list[_StateSlot] = []
         self.operators: list = []  # parallel to slots: op configs
@@ -112,6 +72,14 @@ class _RenderContext:
         # overflow (mutated by the host wrapper, read at trace time).
         self.slot_cap = slot_cap
         self.n_exchanges = 0
+        # Per-join-site output capacity tier (match fan-out is
+        # data-dependent); grown on overflow, read at trace time.
+        self.join_caps: list[int] = []
+        self.default_join_cap = join_cap
+        # Output deltas are shrunk to this tier before the output
+        # arrangement insert, so the insert's sorts compile at a small
+        # capacity regardless of input batch size.
+        self.out_delta_cap = 4096
 
     @property
     def sharded(self) -> bool:
@@ -128,12 +96,18 @@ class _RenderContext:
         self.n_exchanges += 1
         return idx
 
-    def maybe_exchange(self, batch: Batch, key, site: int, ovf: dict):
+    def new_join_site(self) -> int:
+        self.join_caps.append(self.default_join_cap)
+        return len(self.join_caps) - 1
+
+    def maybe_exchange(self, batch: Batch, key, site: int, ovf: dict,
+                       null_aware: bool = True):
         """Route `batch` by `key` to owning workers (no-op single-shard)."""
         if not self.sharded:
             return batch, ovf
         routed, overflow = exchange(
-            batch, key, self.axis_name, self.num_shards, self.slot_cap
+            batch, key, self.axis_name, self.num_shards, self.slot_cap,
+            null_aware,
         )
         ovf = dict(ovf)
         ovf[("x", site)] = overflow
@@ -257,7 +231,7 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
         return run
 
     if isinstance(expr, mir.Reduce):
-        op = ReduceAccumulable(
+        op = ReduceOp(
             expr.input.schema(), expr.group_key, expr.aggregates
         )
         slot = ctx.new_slot(op, op.init_state())
@@ -268,50 +242,224 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
         def run(states, inputs, time):
             b, upd, ovf = inner(states, inputs, time)
             b, ovf = ctx.maybe_exchange(b, group_key, site, ovf)
-            state = states[slot]
-            new_state, out, overflow = op.step(
-                state, b, time, state.capacity
-            )
+            new_state, out, overflow = op.step(states[slot], b, time)
             upd = dict(upd)
             upd[slot] = new_state
             ovf = dict(ovf)
-            ovf[("state", slot)] = overflow
+            for part, flag in overflow.items():
+                ovf[("state", slot, part)] = flag
             return out, upd, ovf
 
         return run
+
+    if isinstance(expr, mir.Let):
+        val = _build(expr.value, ctx)
+        body = _build(expr.body, ctx)
+        name = expr.name
+
+        def run(states, inputs, time):
+            vb, upd, ovf = val(states, inputs, time)
+            # The binding's delta is computed ONCE and shared by every
+            # Get (arrangement sharing analog: NormalizeLets + the
+            # TraceManager let bindings, render_plan.rs bind stages).
+            inner_inputs = dict(inputs)
+            inner_inputs[name] = vb
+            ob, u2, o2 = body(states, inner_inputs, time)
+            return ob, {**upd, **u2}, {**ovf, **o2}
+
+        return run
+
+    if isinstance(expr, mir.Join):
+        return _build_join(expr, ctx)
 
     raise NotImplementedError(
         f"render: {type(expr).__name__} not supported in operator set v0"
     )
 
 
-class _DataflowBase:
-    """Shared host-side machinery: output arrangement + peeks."""
+def _join_stage_keys(expr: mir.Join, offsets: list, stage: int):
+    """Join keys for the linear-join stage bringing in input `stage`:
+    pairs (acc column, right column) from equivalence classes with a
+    member on each side. Analog of JoinImplementation's key selection
+    (transform/src/join_implementation.rs) restricted to column
+    equivalences."""
+    from ..expr.scalar import ColumnRef
 
-    def _init_output(self):
-        out_key = tuple(range(self.out_schema.arity))
-        self.output = Arrangement.empty(self.out_schema, out_key)
-        self._insert_jit = jax.jit(insert, static_argnames=("out_capacity",))
-
-    def _absorb_output(self, out: Batch):
-        """Merge an output delta into the output arrangement (the index
-        export: TraceManager arrangement, render.rs:357)."""
-        while True:
-            new_out, ovf = self._insert_jit(
-                self.output, out, out_capacity=self.output.capacity
-            )
-            if bool(ovf):
-                self.output = Arrangement(
-                    self.output.batch.with_capacity(self.output.capacity * 2),
-                    self.output.key,
+    lo, hi = offsets[stage], offsets[stage + 1]
+    left_key, right_key = [], []
+    consumed = []
+    for ci, cls in enumerate(expr.equivalences):
+        cols = []
+        for e in cls:
+            if not isinstance(e, ColumnRef):
+                raise NotImplementedError(
+                    "join equivalences must be column references "
+                    "(pre-map complex exprs)"
                 )
-                continue
-            break
-        self.output = new_out
+            cols.append(e.index)
+        lefts = [c for c in cols if c < lo]
+        rights = [c for c in cols if lo <= c < hi]
+        if lefts and rights:
+            left_key.append(lefts[0])
+            right_key.append(rights[0] - lo)
+            consumed.append(ci)
+            if len(lefts) > 1 or len(rights) > 1:
+                raise NotImplementedError(
+                    ">2-member equivalence classes need residual filters"
+                )
+    return tuple(left_key), tuple(right_key), consumed
 
-    def peek(self) -> list[tuple]:
-        """Read the full maintained result (SELECT * FROM mv)."""
-        return self.output.batch.to_rows()
+
+def _build_join(expr: mir.Join, ctx: _RenderContext):
+    """Linear join plan: left-fold binary JoinOp stages, each with both
+    sides exchanged on the stage key (JoinPlan::Linear,
+    compute-types/src/plan/join.rs:46; rendering linear_join.rs:204)."""
+    schemas = [i.schema() for i in expr.inputs]
+    offsets = [0]
+    for s in schemas:
+        offsets.append(offsets[-1] + s.arity)
+    inners = [_build(i, ctx) for i in expr.inputs]
+
+    stages = []
+    acc_schema = schemas[0]
+    all_consumed: set = set()
+    for i in range(1, len(expr.inputs)):
+        left_key, right_key, consumed = _join_stage_keys(expr, offsets, i)
+        all_consumed.update(consumed)
+        op = JoinOp(acc_schema, schemas[i], left_key, right_key)
+        slot = ctx.new_slot(op, op.init_state())
+        jsite = ctx.new_join_site()
+        lsite = ctx.new_exchange_site()
+        rsite = ctx.new_exchange_site()
+        stages.append((op, slot, jsite, lsite, rsite, left_key, right_key))
+        acc_schema = op.out_schema
+    if len(all_consumed) != len(expr.equivalences):
+        # An intra-input equality (all members in one input) would be
+        # silently unenforced — the optimizer should have rewritten it
+        # into a Filter; refuse rather than emit wrong rows.
+        raise NotImplementedError(
+            "equivalence class not consumable as a join key "
+            "(intra-input equality: rewrite as Filter)"
+        )
+
+    def run(states, inputs, time):
+        deltas, upd, ovf = [], {}, {}
+        for f in inners:
+            b, u, o = f(states, inputs, time)
+            deltas.append(b)
+            upd.update(u)
+            ovf.update(o)
+        acc = deltas[0]
+        for (op, slot, jsite, lsite, rsite, lkey, rkey), d_right in zip(
+            stages, deltas[1:]
+        ):
+            acc, ovf = ctx.maybe_exchange(
+                acc, lkey, lsite, ovf, null_aware=False
+            )
+            d_right, ovf = ctx.maybe_exchange(
+                d_right, rkey, rsite, ovf, null_aware=False
+            )
+            new_state, out, st_ovf, j_ovf = op.step(
+                states[slot], acc, d_right, time, ctx.join_caps[jsite]
+            )
+            upd = dict(upd)
+            upd[slot] = new_state
+            ovf = dict(ovf)
+            for part, flag in st_ovf.items():
+                ovf[("state", slot, part)] = flag
+            ovf[("join", jsite)] = j_ovf
+            acc = out
+        return acc, upd, ovf
+
+    return run
+
+
+class _DataflowBase:
+    """Shared host-side machinery: pipelined stepping, overflow-driven
+    capacity growth with rollback/replay, peeks.
+
+    The output arrangement (the index export: TraceManager arrangement,
+    render.rs:357) lives ON DEVICE as part of the step state; per-step
+    host traffic is one packed overflow-flag readback, checked once per
+    pipelined run (device->host transfers through the TPU tunnel are the
+    latency cost center, so the hot loop never reads data back)."""
+
+    def _init_output(self, capacity: int = 256):
+        out_key = tuple(range(self.out_schema.arity))
+        self.output = Arrangement.empty(self.out_schema, out_key, capacity)
+        self._ovf_keys: list = []
+
+    def _pack_flags(self, ovf: dict) -> jnp.ndarray:
+        """Deterministically order overflow flags into one tiny array.
+        Captures the key order at trace time (the dict's keys are a
+        static property of the rendered plan)."""
+        keys = sorted(ovf.keys())
+        self._ovf_keys = keys
+        if not keys:
+            return jnp.zeros((0,), jnp.bool_)
+        return jnp.stack(
+            [jnp.asarray(ovf[k]).astype(jnp.bool_).reshape(()) for k in keys]
+        )
+
+    def _grow_for(self, key) -> None:
+        """Grow the capacity tier behind an overflowed key."""
+        if key[0] == "state":
+            _, slot, part = key
+            parts = list(self.states[slot])
+            parts[part] = self._grow_arrangement(parts[part])
+            self.states[slot] = tuple(parts)
+        elif key[0] == "out":
+            self.output = self._grow_arrangement(self.output)
+        elif key[0] == "join":
+            self._ctx.join_caps[key[1]] *= 2
+            self._remake_jit()
+        elif key[0] == "x":
+            self._ctx.slot_cap *= 2
+            self._remake_jit()
+        elif key[0] == "outd":
+            self._ctx.out_delta_cap *= 2
+            self._remake_jit()
+        else:
+            raise AssertionError(f"unknown overflow key {key}")
+
+    def step(self, inputs: dict) -> Batch:
+        """Feed one micro-batch of updates per source; returns the output
+        delta (device-resident) and advances the frontier."""
+        return self.run_steps([inputs])[-1]
+
+    def run_steps(self, inputs_list: list) -> list:
+        """Feed several micro-batches with deferred overflow handling:
+        all steps are submitted asynchronously, the packed overflow flags
+        are read once at the end, and on overflow the whole span is
+        rolled back (states are immutable device values), tiers grown,
+        and the span replayed — steps are pure, so the replay is
+        idempotent. This keeps the hot loop free of per-step syncs."""
+        packed = [self._pack_inputs(i) for i in inputs_list]
+        while True:
+            ck = (list(self.states), self.output, self.time)
+            deltas, flags = [], []
+            for p in packed:
+                t = jnp.asarray(self.time, dtype=jnp.uint64)
+                out, new_states, new_output, fl = self._step_jit(
+                    tuple(self.states), self.output, p, t
+                )
+                self.states = list(new_states)
+                self.output = new_output
+                self.time += 1
+                deltas.append(out)
+                flags.append(fl)
+            if flags and self._ovf_keys:
+                fh = np.asarray(jnp.stack(flags))  # [K, nkeys] or [K, nkeys, P]
+                per_key = fh.reshape(fh.shape[0], len(self._ovf_keys), -1)
+                overflowed = per_key.any(axis=(0, 2))
+            else:
+                overflowed = np.zeros(0, dtype=bool)
+            if overflowed.any():
+                self.states, self.output, self.time = ck
+                for i in np.nonzero(overflowed)[0]:
+                    self._grow_for(self._ovf_keys[i])
+                continue
+            return deltas
 
 
 class Dataflow(_DataflowBase):
@@ -332,41 +480,41 @@ class Dataflow(_DataflowBase):
         self.states = [s.init for s in ctx.slots]
         self._init_output()
         self.time = 0  # frontier: all steps < time are complete
-        self._step_jit = jax.jit(self._step_core)
+        self._remake_jit()
+
+    def _remake_jit(self):
+        # A fresh jit wrapper so trace-time reads of mutable ctx tiers
+        # (join_caps, slot_cap) take effect after growth.
+        self._step_jit = jax.jit(
+            lambda s, o, i, t: self._step_core(s, o, i, t)
+        )
+
+    def _grow_arrangement(self, arr: Arrangement) -> Arrangement:
+        return Arrangement(
+            arr.batch.with_capacity(arr.batch.capacity * 2), arr.key
+        )
+
+    def _pack_inputs(self, inputs: dict) -> dict:
+        return inputs
 
     # pure, jitted once per capacity signature
-    def _step_core(self, states, inputs, time):
+    def _step_core(self, states, output, inputs, time):
         out, upd, ovf = self._run(states, inputs, time)
-        out = consolidate(out)
         new_states = list(states)
         for k, v in upd.items():
             new_states[k] = v
-        return out, tuple(new_states), ovf
+        out, shrink_ovf = shrink(out, self._ctx.out_delta_cap)
+        new_output, out_ovf = insert(
+            output, out, out_capacity=output.capacity
+        )
+        ovf = dict(ovf)
+        ovf[("outd",)] = shrink_ovf
+        ovf[("out",)] = out_ovf
+        return out, tuple(new_states), new_output, self._pack_flags(ovf)
 
-    def step(self, inputs: dict) -> Batch:
-        """Feed one micro-batch of updates per source; returns the output
-        delta at this step's timestamp and advances the frontier."""
-        t = jnp.asarray(self.time, dtype=jnp.uint64)
-        while True:
-            out, new_states, ovf = self._step_jit(
-                tuple(self.states), inputs, t
-            )
-            grown = False
-            for (kind, idx), flag in ovf.items():
-                if kind == "state" and bool(flag):
-                    s = self.states[idx]
-                    self.states[idx] = Arrangement(
-                        s.batch.with_capacity(s.batch.capacity * 2), s.key
-                    )
-                    grown = True
-            if grown:
-                # States were not committed; the retry is idempotent.
-                continue
-            break
-        self.states = list(new_states)
-        self._absorb_output(out)
-        self.time += 1
-        return out
+    def peek(self) -> list[tuple]:
+        """Read the full maintained result (SELECT * FROM mv)."""
+        return self.output.batch.to_rows()
 
 
 def _shard_rows(arrays, n: int, num_shards: int, shard_cap: int):
@@ -395,11 +543,13 @@ class ShardedDataflow(_DataflowBase):
     Worker = device; every stateful operator's state is sharded by key
     hash; inputs are dealt across workers and exchanged on key inside the
     step (the timely model, SURVEY.md §2.4 row 1). One ``shard_map``-ped
-    jitted step per capacity signature.
+    jitted step per capacity signature. Each worker also maintains its
+    own shard of the output arrangement; peeks gather + combine.
     """
 
     def __init__(self, expr: mir.RelationExpr, mesh, name: str = "df",
-                 slot_cap: int = 256, input_shard_cap: int = 1024):
+                 slot_cap: int = 256, input_shard_cap: int = 1024,
+                 output_cap: int = 256):
         self.expr = expr
         self.mesh = mesh
         self.name = name
@@ -423,12 +573,17 @@ class ShardedDataflow(_DataflowBase):
         self.states = [
             self._replicate_empty(s.init) for s in ctx.slots
         ]
-        self._init_output()
+        self._init_output(output_cap)
+        self.output = self._replicate_empty_one(self.output)
         self.time = 0
-        self._make_jit()
+        self._remake_jit()
 
     # -- sharded state layout ----------------------------------------------
-    def _replicate_empty(self, arr: Arrangement) -> Arrangement:
+    def _replicate_empty(self, parts: tuple) -> tuple:
+        """Each worker starts with empty shards of every state part."""
+        return tuple(self._replicate_empty_one(a) for a in parts)
+
+    def _replicate_empty_one(self, arr: Arrangement) -> Arrangement:
         """Each worker starts with an empty shard of this arrangement."""
         P_ = self.num_shards
 
@@ -452,7 +607,7 @@ class ShardedDataflow(_DataflowBase):
         )
         return Arrangement(gb, arr.key)
 
-    def _grow_state(self, arr: Arrangement) -> Arrangement:
+    def _grow_arrangement(self, arr: Arrangement) -> Arrangement:
         """Double every shard's capacity ([P, cap] -> [P, 2cap])."""
         P_ = self.num_shards
         b = arr.batch
@@ -479,53 +634,66 @@ class ShardedDataflow(_DataflowBase):
         return Arrangement(gb, arr.key)
 
     # -- the SPMD step ------------------------------------------------------
-    def _make_jit(self):
+    def _remake_jit(self):
         axis = self.axis_name
 
-        def per_worker(states, inputs, time):
-            # Leaves arrive rank-preserved: counts are [1]; make scalar.
-            states = [
+        def scalar_counts(s):
+            return tuple(
                 Arrangement(
-                    s.batch.replace(count=s.batch.count.reshape(())), s.key
+                    a.batch.replace(count=a.batch.count.reshape(())), a.key
                 )
-                for s in states
-            ]
+                for a in s
+            )
+
+        def vec_counts(s):
+            return tuple(
+                Arrangement(
+                    a.batch.replace(count=a.batch.count.reshape((1,))),
+                    a.key,
+                )
+                for a in s
+            )
+
+        def per_worker(states, output, inputs, time):
+            # Leaves arrive rank-preserved: counts are [1]; make scalar.
+            states = [scalar_counts(s) for s in states]
+            (output,) = scalar_counts((output,))
             inputs = {
                 k: b.replace(count=b.count.reshape(()))
                 for k, b in inputs.items()
             }
             out, upd, ovf = self._run(states, inputs, time)
-            out = consolidate(out)
             new_states = list(states)
             for k, v in upd.items():
                 new_states[k] = v
-            # Rank-1 everything for the shard_map boundary.
-            out = out.replace(count=out.count.reshape((1,)))
-            new_states = tuple(
-                Arrangement(
-                    s.batch.replace(count=s.batch.count.reshape((1,))),
-                    s.key,
-                )
-                for s in new_states
+            out, shrink_ovf = shrink(out, self._ctx.out_delta_cap)
+            new_output, out_ovf = insert(
+                output, out, out_capacity=output.capacity
             )
-            # Overflow anywhere aborts the step on every worker.
-            ovf = {
-                k: (jax.lax.psum(v.astype(jnp.int32), axis) > 0).reshape(
-                    (1,)
-                )
-                for k, v in ovf.items()
-            }
-            return out, new_states, ovf
+            ovf = dict(ovf)
+            ovf[("outd",)] = shrink_ovf
+            ovf[("out",)] = out_ovf
+            # Overflow anywhere aborts the span on every worker.
+            flags = self._pack_flags(ovf)
+            flags = (
+                jax.lax.psum(flags.astype(jnp.int32), axis) > 0
+            ).reshape(-1, 1)
+            # Rank-1 counts for the shard_map boundary.
+            out = out.replace(count=out.count.reshape((1,)))
+            new_states = tuple(vec_counts(s) for s in new_states)
+            (new_output,) = vec_counts((new_output,))
+            return out, new_states, new_output, flags
 
-        def step(states, inputs, time):
+        def step(states, output, inputs, time):
             return jax.shard_map(
                 per_worker,
                 mesh=self.mesh,
-                in_specs=(P(self.axis_name), P(self.axis_name), P()),
+                in_specs=(P(self.axis_name), P(self.axis_name),
+                          P(self.axis_name), P()),
                 out_specs=(P(self.axis_name), P(self.axis_name),
-                           P(self.axis_name)),
+                           P(self.axis_name), P(None, self.axis_name)),
                 check_vma=False,
-            )(states, inputs, time)
+            )(states, output, inputs, time)
 
         self._step_jit = jax.jit(step)
 
@@ -568,8 +736,8 @@ class ShardedDataflow(_DataflowBase):
                 packed[name] = b
         return packed
 
-    def _gather_output(self, out: Batch) -> Batch:
-        """Concatenate every worker's output delta into one host batch."""
+    def _gather_batch(self, out: Batch) -> Batch:
+        """Concatenate every worker's shard rows into one host batch."""
         P_ = self.num_shards
         counts = np.asarray(out.count)
         cap = out.diff.shape[0] // P_
@@ -591,31 +759,17 @@ class ShardedDataflow(_DataflowBase):
             nulls=nulls,
         )
 
-    def step(self, inputs: dict) -> Batch:
-        """Feed one micro-batch (host batches are dealt across workers);
-        returns the gathered output delta and advances the frontier."""
-        t = jnp.asarray(self.time, dtype=jnp.uint64)
-        packed = self._pack_inputs(inputs)
-        while True:
-            out, new_states, ovf = self._step_jit(
-                tuple(self.states), packed, t
-            )
-            grown = False
-            for (kind, idx), flag in ovf.items():
-                if not bool(np.any(np.asarray(flag))):
-                    continue
-                if kind == "state":
-                    self.states[idx] = self._grow_state(self.states[idx])
-                    grown = True
-                elif kind == "x":
-                    self._ctx.slot_cap *= 2
-                    self._make_jit()
-                    grown = True
-            if grown:
-                continue
-            break
-        self.states = list(new_states)
-        host_out = self._gather_output(out)
-        self._absorb_output(host_out)
-        self.time += 1
-        return host_out
+    def gather_delta(self, out: Batch) -> Batch:
+        """Host view of a per-worker output delta from step()."""
+        return self._gather_batch(out)
+
+    def peek(self) -> list[tuple]:
+        """Gather and combine every worker's output-arrangement shard.
+        Different workers may hold the same row value (outputs stay where
+        they were computed), so diffs are summed host-side."""
+        rows = self._gather_batch(self.output.batch).to_rows()
+        acc: dict = {}
+        for r in rows:
+            key = r[:-2]  # value columns only: shards may hold the same
+            acc[key] = acc.get(key, 0) + r[-1]  # row at different times
+        return [k + (0, d) for k, d in acc.items() if d != 0]

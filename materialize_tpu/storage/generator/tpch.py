@@ -271,6 +271,25 @@ class TpchGenerator:
         names = GLOBAL_DICT.encode_many(_REGIONS)
         return [np.arange(5, dtype=np.int64), names]
 
+    def table_batch(self, name: str, time: int = 0) -> Batch:
+        """A static table as one insert batch (dimension-table snapshot)."""
+        schema, cols = {
+            "supplier": (SUPPLIER_SCHEMA, self.supplier_table),
+            "part": (PART_SCHEMA, self.part_table),
+            "partsupp": (PARTSUPP_SCHEMA, self.partsupp_table),
+            "customer": (CUSTOMER_SCHEMA, self.customer_table),
+            "nation": (NATION_SCHEMA, self.nation_table),
+            "region": (REGION_SCHEMA, self.region_table),
+        }[name]
+        cols = cols()
+        n = len(cols[0])
+        return Batch.from_numpy(
+            schema,
+            cols,
+            np.full(n, time, np.uint64),
+            np.ones(n, np.int64),
+        )
+
     # -- streaming interface ------------------------------------------------
     def snapshot_lineitem_batches(
         self, batch_orders: int = 4096, time: int = 0

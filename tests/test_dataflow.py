@@ -127,34 +127,7 @@ class TestReduceDataflow:
         assert touched == {1}
 
 
-def tpch_q1_mir():
-    """TPCH Q1 as MIR over the lineitem schema (sums; avgs derive from
-    sums/counts in finishing)."""
-    sch = LINEITEM_SCHEMA
-    i = sch.index_of
-    cutoff = 8035 + 2526 - 90  # date '1998-12-01' - 90 days, as day number
-    one = lit(100, ColumnType.DECIMAL, 2)  # 1.00
-    disc_price = col(i("l_extendedprice")) * (one - col(i("l_discount")))
-    charge_rhs = one + col(i("l_tax"))
-    expr = (
-        mir.Get("lineitem", sch)
-        .filter([col(i("l_shipdate")).lte(lit(cutoff, ColumnType.DATE))])
-        .map([disc_price])  # -> col 13, scale 4
-        .map([col(13) * charge_rhs])  # -> col 14, scale 6
-        .project([i("l_returnflag"), i("l_linestatus"),
-                  i("l_quantity"), i("l_extendedprice"), 13, 14])
-        .reduce(
-            (0, 1),
-            (
-                AggregateExpr(AggregateFunc.SUM_INT, col(2)),  # sum_qty
-                AggregateExpr(AggregateFunc.SUM_INT, col(3)),  # sum_base
-                AggregateExpr(AggregateFunc.SUM_INT, col(4)),  # sum_disc
-                AggregateExpr(AggregateFunc.SUM_INT, col(5)),  # sum_charge
-                AggregateExpr(AggregateFunc.COUNT, lit(True)),  # count(*)
-            ),
-        )
-    )
-    return expr
+from materialize_tpu.workloads.tpch import q1_mir as tpch_q1_mir  # noqa: E402
 
 
 def q1_oracle(rows, cutoff):
@@ -201,3 +174,84 @@ class TestTpchQ1:
         got = sorted(tuple(r[:-2]) for r in df.peek())
         want = q1_oracle(all_rows, cutoff)
         assert got == want
+
+
+class TestMinMaxReduce:
+    def _dataflow(self):
+        schema = Schema(
+            [Column("k", ColumnType.INT64), Column("v", ColumnType.INT64)]
+        )
+        expr = mir.Get("in", schema).reduce(
+            (0,),
+            (
+                AggregateExpr(AggregateFunc.MIN, col(1)),
+                AggregateExpr(AggregateFunc.MAX, col(1)),
+                AggregateExpr(AggregateFunc.SUM_INT, col(1)),
+            ),
+        )
+        return schema, Dataflow(expr)
+
+    def test_minmax_with_retraction_repair(self):
+        schema, df = self._dataflow()
+        # insert {1: [5, 9, 2], 2: [7]}
+        b1 = _mk_batch(
+            schema,
+            [np.array([1, 1, 1, 2]), np.array([5, 9, 2, 7])],
+            [1, 1, 1, 1],
+            time=0,
+        )
+        df.step({"in": b1})
+        got = sorted(tuple(r[:-2]) for r in df.peek())
+        assert got == [(1, 2, 9, 16), (2, 7, 7, 7)]
+        # retract the current min AND max of group 1: repair must find 5
+        b2 = _mk_batch(
+            schema, [np.array([1, 1]), np.array([2, 9])], [-1, -1], time=1
+        )
+        df.step({"in": b2})
+        got = sorted(tuple(r[:-2]) for r in df.peek())
+        assert got == [(1, 5, 5, 5), (2, 7, 7, 7)]
+
+    def test_minmax_matches_oracle_random(self):
+        schema, df = self._dataflow()
+        rng = np.random.default_rng(11)
+        live = []  # the accumulated multiset, host-side
+        for step in range(5):
+            ins_k = rng.integers(0, 6, 40)
+            ins_v = rng.integers(-100, 100, 40)
+            rows = [(int(k), int(v)) for k, v in zip(ins_k, ins_v)]
+            # retract a random existing subset
+            n_del = min(len(live), int(rng.integers(0, 20)))
+            dels = [
+                live[i]
+                for i in rng.choice(len(live), n_del, replace=False)
+            ] if n_del else []
+            ks = np.array([r[0] for r in rows + dels])
+            vs = np.array([r[1] for r in rows + dels])
+            ds = np.array([1] * len(rows) + [-1] * len(dels))
+            df.step({"in": _mk_batch(schema, [ks, vs], ds, time=step)})
+            live += rows
+            for d in dels:
+                live.remove(d)
+
+        want = {}
+        for k, v in live:
+            mn, mx, s = want.get(k, (None, None, 0))
+            want[k] = (
+                v if mn is None else min(mn, v),
+                v if mx is None else max(mx, v),
+                s + v,
+            )
+        want = sorted((k,) + t for k, t in want.items())
+        got = sorted(tuple(r[:-2]) for r in df.peek())
+        assert got == want
+
+    def test_distinct(self):
+        schema = Schema([Column("k", ColumnType.INT64)])
+        df = Dataflow(mir.Get("in", schema).distinct())
+        b = _mk_batch(schema, [np.array([3, 1, 3, 3, 2])],
+                      [1, 1, 1, 1, 1], time=0)
+        df.step({"in": b})
+        assert sorted(r[0] for r in df.peek()) == [1, 2, 3]
+        b2 = _mk_batch(schema, [np.array([3, 3, 3])], [-1, -1, -1], time=1)
+        df.step({"in": b2})
+        assert sorted(r[0] for r in df.peek()) == [1, 2]

@@ -1,0 +1,141 @@
+"""Linear join tests: incremental binary and 3-way joins against a
+host-side oracle, including retractions and same-batch dA⋈dB pairs."""
+
+import numpy as np
+
+from materialize_tpu.expr import relation as mir
+from materialize_tpu.expr.scalar import col
+from materialize_tpu.render.dataflow import Dataflow
+from materialize_tpu.repr.batch import Batch
+from materialize_tpu.repr.schema import Column, ColumnType, Schema
+
+from .oracle import as_multiset
+
+
+def _mk(schema, cols, diffs, time=0):
+    n = len(diffs)
+    return Batch.from_numpy(
+        schema, cols, np.full(n, time, np.uint64), np.asarray(diffs)
+    )
+
+
+R_SCHEMA = Schema([Column("rk", ColumnType.INT64), Column("rv", ColumnType.INT64)])
+S_SCHEMA = Schema([Column("sk", ColumnType.INT64), Column("sv", ColumnType.INT64)])
+T_SCHEMA = Schema([Column("tk", ColumnType.INT64), Column("tv", ColumnType.INT64)])
+
+
+def join_oracle(r_rows, s_rows):
+    """Multiset inner join on first column -> {row: count} (counts may be
+    negative: retract-before-insert is legal in the update algebra)."""
+    rm = as_multiset(r_rows)
+    sm = as_multiset(s_rows)
+    out = {}
+    for (rk, rv), rc in rm.items():
+        for (sk, sv), sc in sm.items():
+            if rk == sk:
+                row = (rk, rv, sk, sv)
+                out[row] = out.get(row, 0) + rc * sc
+    return {r: c for r, c in out.items() if c != 0}
+
+
+class TestBinaryJoin:
+    def _df(self):
+        expr = mir.Join(
+            (mir.Get("r", R_SCHEMA), mir.Get("s", S_SCHEMA)),
+            equivalences=((col(0), col(2)),),  # rk = sk
+        )
+        return Dataflow(expr)
+
+    def test_insert_only(self):
+        df = self._df()
+        r = _mk(R_SCHEMA, [np.array([1, 1, 2]), np.array([10, 11, 20])],
+                [1, 1, 1])
+        s = _mk(S_SCHEMA, [np.array([1, 2, 3]), np.array([100, 200, 300])],
+                [1, 1, 1])
+        df.step({"r": r, "s": s})
+        got = sorted(tuple(x[:-2]) for x in df.peek())
+        assert got == [(1, 10, 1, 100), (1, 11, 1, 100), (2, 20, 2, 200)]
+
+    def test_retraction_removes_pairs(self):
+        df = self._df()
+        df.step({
+            "r": _mk(R_SCHEMA, [np.array([1, 1]), np.array([10, 11])], [1, 1]),
+            "s": _mk(S_SCHEMA, [np.array([1]), np.array([100])], [1]),
+        })
+        df.step({
+            "r": _mk(R_SCHEMA, [np.array([1]), np.array([10])], [-1], time=1),
+            "s": _mk(S_SCHEMA, [np.zeros(0, np.int64), np.zeros(0, np.int64)], [], time=1),
+        })
+        got = sorted(tuple(x[:-2]) for x in df.peek())
+        assert got == [(1, 11, 1, 100)]
+
+    def test_incremental_random_matches_oracle(self):
+        df = self._df()
+        rng = np.random.default_rng(17)
+        r_all, s_all = [], []
+        for step in range(4):
+            nr, ns = 60, 50
+            rk = rng.integers(0, 12, nr)
+            rv = rng.integers(0, 1000, nr)
+            rd = np.where(rng.random(nr) < 0.25, -1, 1)
+            sk = rng.integers(0, 12, ns)
+            sv = rng.integers(0, 1000, ns)
+            sd = np.where(rng.random(ns) < 0.25, -1, 1)
+            rb = _mk(R_SCHEMA, [rk, rv], rd, time=step)
+            sb = _mk(S_SCHEMA, [sk, sv], sd, time=step)
+            df.step({"r": rb, "s": sb})
+            r_all += rb.to_rows()
+            s_all += sb.to_rows()
+        got = {}
+        for x in df.peek():
+            got[tuple(x[:-2])] = got.get(tuple(x[:-2]), 0) + x[-1]
+        assert got == join_oracle(r_all, s_all)
+
+    def test_null_keys_never_match(self):
+        schema_n = Schema(
+            [Column("k", ColumnType.INT64, nullable=True),
+             Column("v", ColumnType.INT64)]
+        )
+        expr = mir.Join(
+            (mir.Get("r", schema_n), mir.Get("s", S_SCHEMA)),
+            equivalences=((col(0), col(2)),),
+        )
+        df = Dataflow(expr)
+        r = Batch.from_numpy(
+            schema_n,
+            [np.array([1, 1]), np.array([10, 11])],
+            np.zeros(2, np.uint64),
+            np.ones(2, np.int64),
+            nulls=[np.array([False, True]), None],
+        )
+        s = _mk(S_SCHEMA, [np.array([1, 1]), np.array([100, 101])], [1, 1])
+        df.step({"r": r, "s": s})
+        got = sorted(tuple(x[:2]) + tuple(x[2:4]) for x in df.peek())
+        # only the non-null r row joins
+        assert {g[1] for g in got} == {10}
+        assert len(got) == 2
+
+
+class TestThreeWayJoin:
+    def test_chain(self):
+        # r.rk = s.sk, s.sv = t.tk  (chain through different columns)
+        expr = mir.Join(
+            (mir.Get("r", R_SCHEMA), mir.Get("s", S_SCHEMA),
+             mir.Get("t", T_SCHEMA)),
+            equivalences=((col(0), col(2)), (col(3), col(4))),
+        )
+        df = Dataflow(expr)
+        r = _mk(R_SCHEMA, [np.array([1, 2]), np.array([10, 20])], [1, 1])
+        s = _mk(S_SCHEMA, [np.array([1, 2]), np.array([7, 8])], [1, 1])
+        t = _mk(T_SCHEMA, [np.array([7, 9]), np.array([70, 90])], [1, 1])
+        df.step({"r": r, "s": s, "t": t})
+        got = sorted(tuple(x[:-2]) for x in df.peek())
+        assert got == [(1, 10, 1, 7, 7, 70)]
+        # late-arriving t row matches existing s
+        df.step({
+            "r": _mk(R_SCHEMA, [np.zeros(0, np.int64)] * 2, [], time=1),
+            "s": _mk(S_SCHEMA, [np.zeros(0, np.int64)] * 2, [], time=1),
+            "t": _mk(T_SCHEMA, [np.array([8]), np.array([80])], [1], time=1),
+        })
+        got = sorted(tuple(x[:-2]) for x in df.peek())
+        assert got == [(1, 10, 1, 7, 7, 70), (2, 20, 2, 8, 8, 80)]

@@ -1,0 +1,80 @@
+"""TPCH Q15 maintained incrementally: Let sharing + accumulable SUM +
+global MAX (empty group key) + 3-input linear join, vs a host oracle."""
+
+import numpy as np
+
+from materialize_tpu.render.dataflow import Dataflow
+from materialize_tpu.storage.generator.tpch import (
+    LINEITEM_SCHEMA,
+    TpchGenerator,
+)
+from materialize_tpu.workloads.tpch import Q15_HI, Q15_LO, q15_mir
+
+from .oracle import as_multiset
+
+
+def q15_oracle(lineitem_rows, gen: TpchGenerator):
+    idx = {c.name: i for i, c in enumerate(LINEITEM_SCHEMA.columns)}
+    ms = as_multiset(lineitem_rows)
+    revenue = {}
+    for data, c in ms.items():
+        sd = data[idx["l_shipdate"]]
+        if not (Q15_LO <= sd < Q15_HI):
+            continue
+        sk = data[idx["l_suppkey"]]
+        amt = data[idx["l_extendedprice"]] * (100 - data[idx["l_discount"]])
+        revenue[sk] = revenue.get(sk, 0) + amt * c
+    revenue = {k: v for k, v in revenue.items()}
+    # groups remain while they have rows; here every supplier with any
+    # in-window row (count>0) appears. Track counts too.
+    counts = {}
+    for data, c in ms.items():
+        sd = data[idx["l_shipdate"]]
+        if not (Q15_LO <= sd < Q15_HI):
+            continue
+        sk = data[idx["l_suppkey"]]
+        counts[sk] = counts.get(sk, 0) + c
+    revenue = {k: v for k, v in revenue.items() if counts.get(k, 0) != 0}
+    if not revenue:
+        return []
+    mx = max(revenue.values())
+    skeys, _, names = gen.supplier_table()
+    name_of = dict(zip(skeys.tolist(), names.tolist()))
+    return sorted(
+        (int(k), name_of[int(k)], int(v))
+        for k, v in revenue.items()
+        if v == mx
+    )
+
+
+class TestTpchQ15:
+    def test_q15_maintained_incrementally(self):
+        gen = TpchGenerator(sf=0.002, seed=9)
+        df = Dataflow(q15_mir())
+        supplier = gen.table_batch("supplier")
+        all_rows = []
+        first = True
+        for b in gen.snapshot_lineitem_batches(batch_orders=1024, time=0):
+            inputs = {"lineitem": b}
+            inputs["supplier"] = supplier if first else _empty_sup(gen)
+            first = False
+            df.step(inputs)
+            all_rows += b.to_rows()
+        for tick in range(4):
+            b = gen.churn_lineitem_batch(96, tick, time=df.time)
+            df.step({"lineitem": b, "supplier": _empty_sup(gen)})
+            all_rows += b.to_rows()
+            got = {}
+            for r in df.peek():
+                got[tuple(r[:-2])] = got.get(tuple(r[:-2]), 0) + r[-1]
+            want = q15_oracle(all_rows, gen)
+            assert sorted(k for k, c in got.items() if c > 0) == want, (
+                f"tick {tick}"
+            )
+
+
+def _empty_sup(gen):
+    from materialize_tpu.repr.batch import Batch
+    from materialize_tpu.storage.generator.tpch import SUPPLIER_SCHEMA
+
+    return Batch.empty(SUPPLIER_SCHEMA, 256)
