@@ -157,6 +157,9 @@ class LetRec(RelationExpr):
     values: tuple  # RelationExpr per binding (may Get any binding name)
     value_schemas: tuple  # declared schema per binding
     body: RelationExpr
+    # Iteration cap (reference LetRecLimit / RETURN AT RECURSION LIMIT,
+    # expr/src/relation.rs LetRec limits). None = run to fixpoint.
+    max_iters: int | None = None
 
     def schema(self):
         return self.body.schema()
@@ -232,6 +235,10 @@ class Join(RelationExpr):
 
     inputs: tuple
     equivalences: tuple  # tuple of tuples of ScalarExpr
+    # "auto" | "linear" | "delta" — JoinImplementation's decision
+    # (transform/src/join_implementation.rs). auto: delta for >=3 inputs
+    # (the delta join's sweet spot; delta_join.rs:10-12), linear for 2.
+    implementation: str = "auto"
 
     def schema(self):
         cols = []

@@ -1,0 +1,265 @@
+"""Delta join: one update pipeline per input, probing the other inputs'
+arrangements — no intermediate join state.
+
+Analog of the reference's delta-query join
+(compute/src/render/join/delta_join.rs:51; dogs³ ``half_join`` at
+:459,503; plan at compute-types/src/plan/join.rs ``JoinPlan::Delta``):
+for a k-way join, the step-t output delta is
+
+    d(I₀ ⋈ … ⋈ I_{k-1}) = Σ_i  dI_i ⋈ (⋈_{j<i} I_j^new) ⋈ (⋈_{j>i} I_j^old)
+
+— pipeline i extends input i's delta through every other input, using the
+post-update arrangement for inputs before it and the pre-update
+arrangement for inputs after it, so each combination of concurrent deltas
+is counted exactly once. The only state is one arrangement per (input,
+probe key) — shared across pipelines, the reference's shared-index
+economy (delta_join.rs:10-12, "no intermediate state") — which is why
+64-way joins are feasible.
+
+Each probe is the fixed-shape two-pass range-expand of the linear join
+(ops/join.py); overflow retries at a larger tier.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..arrangement.spine import Arrangement, insert, lookup_range
+from ..expr.scalar import ColumnRef
+from ..ops.join import expand_ranges, null_key_diffs
+from ..ops.lanes import column_lanes, key_lanes
+from ..ops.sort import concat_batches
+from ..repr.batch import Batch
+from ..repr.schema import Column, Schema
+
+
+def _plan_pipelines(n_inputs: int, arities, equivalences):
+    """Per-pipeline probe plans.
+
+    Returns (pipelines, arrangement_specs):
+      pipelines[i] = list of steps (j, acc_key_positions, j_key_locals,
+                     arr_index) — probe input j's arrangement keyed by
+                     j_key_locals, matching acc columns at
+                     acc_key_positions (positions in the pipeline's
+                     accumulated column list, which is the concat of bound
+                     inputs' columns in probe order);
+      arrangement_specs = list of (input j, key tuple of j-local cols).
+    """
+    offsets = [0]
+    for a in arities:
+        offsets.append(offsets[-1] + a)
+
+    def owner(g):
+        for j in range(n_inputs):
+            if offsets[j] <= g < offsets[j + 1]:
+                return j
+        raise IndexError(g)
+
+    classes = []
+    for cls in equivalences:
+        cols = []
+        for e in cls:
+            if not isinstance(e, ColumnRef):
+                raise NotImplementedError(
+                    "delta join equivalences must be column references"
+                )
+            cols.append(e.index)
+        per_input = {}
+        for g in cols:
+            per_input.setdefault(owner(g), []).append(g)
+        for j, members in per_input.items():
+            if len(members) > 1:
+                raise NotImplementedError(
+                    "intra-input equality in join equivalence: rewrite "
+                    "as a Filter before planning"
+                )
+        if len(per_input) < 2:
+            raise NotImplementedError(
+                "equivalence class confined to one input"
+            )
+        classes.append({j: ms[0] for j, ms in per_input.items()})
+
+    arrangement_specs: list = []
+    arr_index: dict = {}
+
+    def get_arr(j, key):
+        k = (j, tuple(key))
+        if k not in arr_index:
+            arr_index[k] = len(arrangement_specs)
+            arrangement_specs.append(k)
+        return arr_index[k]
+
+    pipelines = []
+    for i in range(n_inputs):
+        bound = [i]
+        # global col -> position in accumulated columns
+        acc_pos = {
+            offsets[i] + c: c for c in range(arities[i])
+        }
+        steps = []
+        remaining = [j for j in range(n_inputs) if j != i]
+        while remaining:
+            picked = None
+            for j in remaining:
+                pairs = []
+                for cls in classes:
+                    if j in cls and any(b in cls for b in bound):
+                        b = next(b for b in bound if b in cls)
+                        pairs.append((acc_pos[cls[b]], cls[j] - offsets[j]))
+                if pairs:
+                    picked = (j, pairs)
+                    break
+            if picked is None:
+                # Disconnected join graph: cross-join the next input.
+                j = remaining[0]
+                picked = (j, [])
+            j, pairs = picked
+            acc_key = tuple(p for p, _ in pairs)
+            j_key = tuple(q for _, q in pairs)
+            n_acc = len(acc_pos)
+            for c in range(arities[j]):
+                acc_pos[offsets[j] + c] = n_acc + c
+            steps.append((j, acc_key, j_key, get_arr(j, j_key)))
+            bound.append(j)
+            remaining.remove(j)
+        # Canonical projection: global column order -> acc positions.
+        proj = tuple(acc_pos[g] for g in range(offsets[-1]))
+        pipelines.append((steps, proj))
+    return pipelines, arrangement_specs
+
+
+@dataclass
+class DeltaJoinOp:
+    """State: one Arrangement per (input, probe-key) pair (shared by all
+    pipelines). Output schema: concat of input schemas (MIR Join)."""
+
+    input_schemas: tuple
+    equivalences: tuple
+
+    def __post_init__(self):
+        self.n_inputs = len(self.input_schemas)
+        arities = [s.arity for s in self.input_schemas]
+        self.pipelines, self.arr_specs = _plan_pipelines(
+            self.n_inputs, arities, self.equivalences
+        )
+        self.out_schema = Schema(
+            tuple(c for s in self.input_schemas for c in s.columns)
+        )
+        # State schemas: key columns normalized non-nullable (null keys
+        # never join; ops/join.py convention).
+        self.arr_schemas = []
+        for j, key in self.arr_specs:
+            s = self.input_schemas[j]
+            cols = [
+                Column(c.name, c.ctype, False, c.scale)
+                if ci in key
+                else c
+                for ci, c in enumerate(s.columns)
+            ]
+            self.arr_schemas.append(Schema(cols))
+        self.n_parts = len(self.arr_specs)
+
+    def init_state(self, capacity: int = 256) -> tuple:
+        return tuple(
+            Arrangement.empty(sch, key, capacity)
+            for (j, key), sch in zip(self.arr_specs, self.arr_schemas)
+        )
+
+    def _probe(self, acc: Batch, arr: Arrangement, acc_key, out_time,
+               out_capacity: int):
+        """acc ⋈ arr on acc_key: returns (extended acc, overflow).
+
+        Probe lanes must match the arrangement's key-lane layout, whose
+        key columns are normalized NON-nullable (null keys never join) —
+        so encode value lanes only and zero the diff of null-key probe
+        rows instead of emitting a null lane."""
+        probe_lanes = []
+        diff = acc.diff
+        for i in acc_key:
+            col = acc.schema[i]
+            nl = acc.nulls[i]
+            if nl is not None:
+                diff = jnp.where(nl, 0, diff)
+            probe_lanes.extend(column_lanes(acc.cols[i], col.ctype))
+        if not probe_lanes:
+            probe_lanes = [jnp.zeros(acc.capacity, dtype=jnp.uint64)]
+        acc = acc.replace(diff=diff)
+        lo, hi = lookup_range(arr, probe_lanes)
+        valid = jnp.logical_and(acc.valid_mask(), acc.diff != 0)
+        probe_idx, match, out_valid, overflow = expand_ranges(
+            lo, hi, valid, out_capacity
+        )
+
+        def g_acc(a):
+            return None if a is None else a[probe_idx]
+
+        def g_arr(a):
+            return None if a is None else a[match]
+
+        out = Batch(
+            cols=tuple(g_acc(c) for c in acc.cols)
+            + tuple(g_arr(c) for c in arr.batch.cols),
+            nulls=tuple(g_acc(n) for n in acc.nulls)
+            + tuple(g_arr(n) for n in arr.batch.nulls),
+            time=jnp.full(out_capacity, out_time, dtype=jnp.uint64),
+            diff=jnp.where(
+                out_valid, acc.diff[probe_idx] * arr.batch.diff[match], 0
+            ),
+            count=jnp.sum(out_valid.astype(jnp.int32)),
+            schema=Schema(
+                tuple(acc.schema.columns) + tuple(arr.batch.schema.columns)
+            ),
+        )
+        return out, overflow
+
+    def step(self, state: tuple, deltas: list, out_time, out_capacity: int,
+             exchange_fn=None):
+        """Process one delta batch per input.
+
+        exchange_fn(batch, key_cols, tag) -> batch: SPMD routing hook
+        applied before every arrangement insert and probe (identity when
+        None). Returns (new_state, out_delta, state_overflow: dict
+        part->flag, probe_overflow)."""
+        route = exchange_fn or (lambda b, key, tag: b)
+
+        # Insert every input's delta into each of its arrangements.
+        new_state = list(state)
+        st_ovf = {}
+        for p, ((j, key), sch) in enumerate(
+            zip(self.arr_specs, self.arr_schemas)
+        ):
+            d = deltas[j].replace(
+                diff=null_key_diffs(deltas[j], key), schema=sch
+            )
+            d = route(d, key, ("ins", p))
+            new_state[p], st_ovf[p] = insert(
+                state[p], d, state[p].capacity
+            )
+
+        probe_ovf = jnp.asarray(False)
+        outs = []
+        for i, (steps, proj) in enumerate(self.pipelines):
+            acc = deltas[i]
+            for j, acc_key, j_key, ap in steps:
+                # Before/after discipline: inputs already processed as
+                # pipelines (j < i) probe post-update arrangements.
+                arr = new_state[ap] if j < i else state[ap]
+                acc = route(acc, acc_key, ("probe", i, ap))
+                acc, ovf = self._probe(
+                    acc, arr, acc_key, out_time, out_capacity
+                )
+                probe_ovf = jnp.logical_or(probe_ovf, ovf)
+            # Canonical column order.
+            outs.append(
+                Batch(
+                    cols=tuple(acc.cols[p] for p in proj),
+                    nulls=tuple(acc.nulls[p] for p in proj),
+                    time=acc.time,
+                    diff=acc.diff,
+                    count=acc.count,
+                    schema=self.out_schema,
+                )
+            )
+        return tuple(new_state), concat_batches(outs), st_ovf, probe_ovf

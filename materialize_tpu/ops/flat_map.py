@@ -1,0 +1,67 @@
+"""FlatMap: table functions with data-dependent fan-out.
+
+Analog of the reference's FlatMap rendering (compute/src/render/flat_map.rs;
+table funcs under expr/src/relation/func.rs). Fan-out is data-dependent, so
+the TPU version uses the same two-pass count-then-expand scheme as the join
+probe (ops/join.py expand_ranges): per-row output counts -> cumulative sum
+-> gather into a fixed-capacity tier, overflow retried host-side at a
+larger tier (SURVEY.md §7 hard part #1).
+
+v1 table functions: ``generate_series(start, stop)`` (step 1, inclusive).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..expr.scalar import eval_expr
+from ..ops.join import expand_ranges
+from ..repr.batch import Batch
+from ..repr.schema import Schema
+
+
+def flat_map(
+    batch: Batch,
+    func: str,
+    exprs: tuple,
+    out_schema: Schema,
+    out_time,
+    out_capacity: int,
+):
+    """Apply a table function to every input row.
+
+    Returns (out_batch, overflow). Output columns: input cols ++ the
+    function's output cols (MIR FlatMap appends, relation.rs FlatMap).
+    """
+    if func != "generate_series":
+        raise NotImplementedError(f"table function {func}")
+    start = eval_expr(exprs[0], batch)
+    stop = eval_expr(exprs[1], batch)
+    null = jnp.logical_or(start.null_mask(), stop.null_mask())
+    n = jnp.clip(
+        stop.values.astype(jnp.int64) - start.values.astype(jnp.int64) + 1,
+        0,
+        None,
+    )
+    n = jnp.where(null, 0, n).astype(jnp.int32)
+    valid = jnp.logical_and(batch.valid_mask(), batch.diff != 0)
+    zeros = jnp.zeros_like(n)
+    probe, k, out_valid, overflow = expand_ranges(
+        zeros, n, valid, out_capacity
+    )
+
+    def g(a):
+        return None if a is None else a[probe]
+
+    series = start.values.astype(jnp.int64)[probe] + k.astype(jnp.int64)
+    cols = tuple(g(c) for c in batch.cols) + (series,)
+    nulls = tuple(g(nl) for nl in batch.nulls) + (None,)
+    out = Batch(
+        cols=cols,
+        nulls=nulls,
+        time=jnp.full(out_capacity, out_time, dtype=jnp.uint64),
+        diff=jnp.where(out_valid, batch.diff[probe], 0),
+        count=jnp.sum(out_valid.astype(jnp.int32)),
+        schema=out_schema,
+    )
+    return out, overflow

@@ -1,0 +1,64 @@
+"""Threshold: retain records with positive multiplicity.
+
+Analog of the reference's Threshold rendering
+(compute/src/render/threshold.rs; MIR variant expr/src/relation.rs:100):
+``output multiplicity = max(input multiplicity, 0)``. The reference keeps
+the input arranged by the full row; the TPU version keeps the same state —
+an Arrangement keyed by every column (the consolidated multiset) — and per
+delta batch computes, for each distinct updated row value,
+
+    d_out = max(old + d, 0) - max(old, 0)
+
+with one binary-search gather of the old multiplicity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..arrangement.spine import Arrangement, arrange, insert, lookup_range
+from ..ops.lanes import key_lanes
+from ..ops.sort import compact
+from ..repr.batch import Batch
+from ..repr.schema import Schema
+
+
+@dataclass
+class ThresholdOp:
+    """State: one Arrangement keyed by all columns. n_parts = 1."""
+
+    schema: Schema
+
+    def __post_init__(self):
+        self.out_schema = self.schema
+        self.key = tuple(range(self.schema.arity))
+        self.n_parts = 1
+
+    def init_state(self, capacity: int = 256) -> tuple:
+        return (Arrangement.empty(self.schema, self.key, capacity),)
+
+    def step(self, state: tuple, delta: Batch, out_time):
+        """Returns (new_state, out_delta, overflow: dict part->flag)."""
+        (arr,) = state
+        # Distinct updated row values with summed delta diffs, sorted so
+        # the state lookup is one lex search.
+        d = arrange(delta, self.key)
+        probe_lanes = key_lanes(d.batch, self.key)
+        lo, hi = lookup_range(arr, probe_lanes)
+        found = hi > lo
+        idx = jnp.clip(lo, 0, max(arr.capacity - 1, 0))
+        old = jnp.where(found, arr.batch.diff[idx], 0)
+        valid = d.batch.valid_mask()
+        dd = jnp.where(valid, d.batch.diff, 0)
+        new = old + dd
+        zero = jnp.zeros_like(old)
+        out_diff = jnp.maximum(new, zero) - jnp.maximum(old, zero)
+        out = d.batch.replace(
+            diff=out_diff,
+            time=jnp.full(d.batch.capacity, out_time, dtype=jnp.uint64),
+        )
+        out = compact(out, out_diff != 0)
+        new_arr, overflow = insert(arr, delta, arr.capacity)
+        return (new_arr,), out, {0: overflow}

@@ -1,0 +1,176 @@
+"""TopK: per-group top-k rows by an ordering, with offset/limit.
+
+Analog of the reference's TopK plans (compute-types/src/plan/top_k.rs:28;
+rendered at compute/src/render/top_k.rs). The reference specializes three
+ways — MonotonicTop1, MonotonicTopK (consolidating monoids for append-only
+inputs) and Basic (multi-stage bucketed arrangement). The TPU re-cast needs
+no bucketing: the state is ONE arrangement of the full input sorted by
+(group key, order-encoding lanes), so the top-k window of every group is a
+contiguous row range, and per-row output multiplicity falls out of a
+segmented prefix sum over diffs:
+
+    out_mult(row) = clip(prefix + diff, offset, offset+limit)
+                  - clip(prefix,        offset, offset+limit)
+
+Update handling diffs the window before and after the state insert,
+restricted to groups touched by the delta batch; unchanged window rows
+cancel in consolidation. This is change-propagation-exact: retractions
+inside the window pull rows in from beyond the limit boundary
+automatically (the reference needs its bucket hierarchy for exactly this).
+
+Ordering uses the same order-preserving uint64 lane encoding as sorting
+(ops/lanes.py), with lanes bit-complemented for DESC and the null lane
+inverted for NULLS LAST — stored as extra int64 state columns (sign-flip
+keeps uint64 order through the int64 round-trip).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..arrangement.spine import Arrangement, arrange, insert
+from ..ops.consolidate import consolidate
+from ..ops.lanes import column_lanes, key_lanes
+from ..ops.search import lex_searchsorted
+from ..ops.sort import compact, concat_batches, segment_ids, segment_starts
+from ..repr.batch import Batch
+from ..repr.schema import Column, ColumnType, Schema
+
+_SIGN64 = jnp.uint64(1 << 63)
+_NO_LIMIT = 1 << 62
+
+
+def order_lane_arrays(batch: Batch, order_by) -> list[jnp.ndarray]:
+    """Order-encoding uint64 lanes for an ORDER BY spec: ascending
+    lexicographic comparison of the lanes == the requested row order.
+    order_by: tuples (col_index, desc, nulls_last)."""
+    lanes = []
+    for col_idx, desc, nulls_last in order_by:
+        col = batch.schema[col_idx]
+        if not col.ctype.is_orderable_on_device:
+            raise NotImplementedError(
+                f"ORDER BY on {col.ctype} (dictionary codes are not "
+                "order-preserving)"
+            )
+        arr = batch.cols[col_idx]
+        nulls = batch.nulls[col_idx]
+        val_lanes = list(column_lanes(arr, col.ctype))
+        if desc:
+            val_lanes = [~l for l in val_lanes]
+        if col.nullable:
+            if nulls is None:
+                nulls = jnp.zeros(arr.shape, dtype=bool)
+            null_first = jnp.where(nulls, jnp.uint64(0), jnp.uint64(1))
+            null_last = jnp.where(nulls, jnp.uint64(1), jnp.uint64(0))
+            lanes.append(null_last if nulls_last else null_first)
+            val_lanes = [
+                jnp.where(nulls, jnp.uint64(0), l) for l in val_lanes
+            ]
+        lanes.extend(val_lanes)
+    return lanes
+
+
+@dataclass
+class TopKOp:
+    """State: one Arrangement over input cols ++ order-lane cols, keyed by
+    (group cols, order-lane cols). n_parts = 1."""
+
+    input_schema: Schema
+    group_key: tuple
+    order_by: tuple  # (col_index, desc, nulls_last) per key
+    limit: int | None
+    offset: int = 0
+
+    def __post_init__(self):
+        self.arity = self.input_schema.arity
+        self.n_parts = 1
+        self.out_schema = self.input_schema
+        # One int64 state column per order lane (count is schema-static).
+        self.n_order_lanes = 0
+        for col_idx, _, _ in self.order_by:
+            col = self.input_schema[col_idx]
+            n = 2 if col.ctype is ColumnType.FLOAT64 else 1
+            self.n_order_lanes += n + (1 if col.nullable else 0)
+        lane_cols = [
+            Column(f"__o{i}__", ColumnType.INT64, False)
+            for i in range(self.n_order_lanes)
+        ]
+        self.state_schema = Schema(
+            tuple(self.input_schema.columns) + tuple(lane_cols)
+        )
+        self.state_key = tuple(self.group_key) + tuple(
+            range(self.arity, self.arity + self.n_order_lanes)
+        )
+
+    def init_state(self, capacity: int = 256) -> tuple:
+        return (
+            Arrangement.empty(self.state_schema, self.state_key, capacity),
+        )
+
+    def _to_state(self, delta: Batch) -> Batch:
+        lanes = order_lane_arrays(delta, self.order_by)
+        cols = list(delta.cols) + [
+            (l ^ _SIGN64).astype(jnp.int64) for l in lanes
+        ]
+        nulls = list(delta.nulls) + [None] * self.n_order_lanes
+        return delta.replace(
+            cols=tuple(cols), nulls=tuple(nulls), schema=self.state_schema
+        )
+
+    def _emit(self, arr: Arrangement, touched: Arrangement, out_time,
+              negate: bool) -> Batch:
+        """Per-row window multiplicity over `arr`, restricted to groups
+        present in `touched`; returns rows (input cols only) with diffs
+        (negated for the pre-update emission)."""
+        b = arr.batch
+        cap = b.capacity
+        glanes = key_lanes(b, self.group_key)
+        # Membership: is this row's group among the touched groups?
+        tlanes = key_lanes(touched.batch, self.group_key)
+        lo = lex_searchsorted(tlanes, touched.batch.count, glanes, "left")
+        hi = lex_searchsorted(tlanes, touched.batch.count, glanes, "right")
+        member = hi > lo
+        valid = b.valid_mask()
+        starts = segment_starts(glanes, b.count, cap)
+        seg = segment_ids(starts)
+        d = jnp.where(valid, b.diff, 0)
+        incl = jnp.cumsum(d)
+        excl = incl - d
+        seg_base = jnp.zeros(cap, dtype=excl.dtype).at[seg].add(
+            jnp.where(starts, excl, 0), mode="drop"
+        )
+        prefix = excl - seg_base[seg]
+        lo_b = jnp.int64(self.offset)
+        hi_b = jnp.int64(
+            self.offset + (self.limit if self.limit is not None else _NO_LIMIT)
+        )
+        mult = jnp.clip(prefix + d, lo_b, hi_b) - jnp.clip(prefix, lo_b, hi_b)
+        mult = jnp.where(jnp.logical_and(valid, member), mult, 0)
+        out = Batch(
+            cols=b.cols[: self.arity],
+            nulls=b.nulls[: self.arity],
+            time=jnp.full(cap, out_time, dtype=jnp.uint64),
+            diff=-mult if negate else mult,
+            count=b.count,
+            schema=self.input_schema,
+        )
+        return compact(out, out.diff != 0)
+
+    def step(self, state: tuple, delta: Batch, out_time):
+        """Returns (new_state, out_delta, overflow: dict part->flag)."""
+        (arr,) = state
+        sdelta = self._to_state(delta)
+        # Sorted distinct-ish delta rows double as the touched-group list
+        # (lex search tolerates duplicate probe targets).
+        touched = arrange(sdelta, self.state_key)
+        new_arr, overflow = insert(arr, sdelta, arr.capacity)
+        out_old = self._emit(arr, touched, out_time, negate=True)
+        out_new = self._emit(new_arr, touched, out_time, negate=False)
+        # Unchanged window rows appear as (-m, +m) pairs; consolidation
+        # cancels them so only genuine window changes flow downstream.
+        out = consolidate(
+            concat_batches([out_old, out_new]), include_time=False
+        )
+        return (new_arr,), out, {0: overflow}

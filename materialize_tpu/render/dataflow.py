@@ -40,8 +40,12 @@ from ..arrangement.spine import Arrangement, arrange, insert
 from ..expr import relation as mir
 from ..expr.linear import MapFilterProject, apply_mfp
 from ..ops.consolidate import consolidate
+from ..ops.delta_join import DeltaJoinOp
+from ..ops.flat_map import flat_map
 from ..ops.join import JoinOp
 from ..ops.reduce import ReduceOp
+from ..ops.threshold import ThresholdOp
+from ..ops.topk import TopKOp
 from ..ops.sort import concat_batches, shrink
 from ..parallel.exchange import exchange
 from ..parallel.mesh import WORKER_AXIS, worker_sharding
@@ -76,6 +80,9 @@ class _RenderContext:
         # data-dependent); grown on overflow, read at trace time.
         self.join_caps: list[int] = []
         self.default_join_cap = join_cap
+        # Per-LetRec-site binding-delta capacity tier.
+        self.letrec_caps: list[int] = []
+        self.default_letrec_cap = 2048
         # Output deltas are shrunk to this tier before the output
         # arrangement insert, so the insert's sorts compile at a small
         # capacity regardless of input batch size.
@@ -272,6 +279,74 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
     if isinstance(expr, mir.Join):
         return _build_join(expr, ctx)
 
+    if isinstance(expr, mir.LetRec):
+        return _build_letrec(expr, ctx)
+
+    if isinstance(expr, mir.Threshold):
+        op = ThresholdOp(expr.input.schema())
+        slot = ctx.new_slot(op, op.init_state())
+        site = ctx.new_exchange_site()
+        inner = _build(expr.input, ctx)
+        all_cols = tuple(range(expr.input.schema().arity))
+
+        def run(states, inputs, time):
+            b, upd, ovf = inner(states, inputs, time)
+            b, ovf = ctx.maybe_exchange(b, all_cols, site, ovf)
+            new_state, out, overflow = op.step(states[slot], b, time)
+            upd = dict(upd)
+            upd[slot] = new_state
+            ovf = dict(ovf)
+            for part, flag in overflow.items():
+                ovf[("state", slot, part)] = flag
+            return out, upd, ovf
+
+        return run
+
+    if isinstance(expr, mir.TopK):
+        op = TopKOp(
+            expr.input.schema(), expr.group_key, expr.order_by,
+            expr.limit, expr.offset,
+        )
+        slot = ctx.new_slot(op, op.init_state())
+        site = ctx.new_exchange_site()
+        inner = _build(expr.input, ctx)
+        group_key = expr.group_key
+
+        def run(states, inputs, time):
+            b, upd, ovf = inner(states, inputs, time)
+            b, ovf = ctx.maybe_exchange(b, group_key, site, ovf)
+            new_state, out, overflow = op.step(states[slot], b, time)
+            upd = dict(upd)
+            upd[slot] = new_state
+            ovf = dict(ovf)
+            for part, flag in overflow.items():
+                ovf[("state", slot, part)] = flag
+            return out, upd, ovf
+
+        return run
+
+    if isinstance(expr, mir.FlatMap):
+        inner = _build(expr.input, ctx)
+        fsite = ctx.new_join_site()  # fan-out capacity tier, like a join
+        out_schema = expr.schema()
+        func, exprs = expr.func, expr.exprs
+
+        def run(states, inputs, time):
+            b, upd, ovf = inner(states, inputs, time)
+            out, overflow = flat_map(
+                b, func, exprs, out_schema, time, ctx.join_caps[fsite]
+            )
+            ovf = dict(ovf)
+            ovf[("join", fsite)] = overflow
+            return out, upd, ovf
+
+        return run
+
+    if isinstance(expr, mir.ArrangeBy):
+        # Arrangement sharing across operators is implicit (Let bindings
+        # compute each delta once); ArrangeBy is a planner hint here.
+        return _build(expr.input, ctx)
+
     raise NotImplementedError(
         f"render: {type(expr).__name__} not supported in operator set v0"
     )
@@ -311,6 +386,66 @@ def _join_stage_keys(expr: mir.Join, offsets: list, stage: int):
 
 
 def _build_join(expr: mir.Join, ctx: _RenderContext):
+    impl = expr.implementation
+    if impl == "auto":
+        impl = "delta" if len(expr.inputs) >= 3 else "linear"
+    if impl == "delta":
+        return _build_join_delta(expr, ctx)
+    return _build_join_linear(expr, ctx)
+
+
+def _build_join_delta(expr: mir.Join, ctx: _RenderContext):
+    """Delta join plan: per-input update pipelines over shared arrangements
+    (JoinPlan::Delta, compute-types/src/plan/join.rs; delta_join.rs:51).
+    In SPMD mode every arrangement insert and every probe is preceded by
+    an all_to_all on the relevant key (the half_join exchange)."""
+    schemas = [i.schema() for i in expr.inputs]
+    op = DeltaJoinOp(tuple(schemas), expr.equivalences)
+    slot = ctx.new_slot(op, op.init_state())
+    jsite = ctx.new_join_site()
+    inners = [_build(i, ctx) for i in expr.inputs]
+    ex_sites = {}
+    for p in range(len(op.arr_specs)):
+        ex_sites[("ins", p)] = ctx.new_exchange_site()
+    for i, (steps, _) in enumerate(op.pipelines):
+        for j, acc_key, j_key, ap in steps:
+            ex_sites[("probe", i, ap)] = ctx.new_exchange_site()
+
+    def run(states, inputs, time):
+        deltas, upd, ovf = [], {}, {}
+        for f in inners:
+            b, u, o = f(states, inputs, time)
+            deltas.append(b)
+            upd.update(u)
+            ovf.update(o)
+
+        ovf_box = {"d": dict(ovf)}
+
+        def exchange_fn(b, key, tag):
+            b2, ovf_box["d"] = ctx.maybe_exchange(
+                b, key, ex_sites[tag], ovf_box["d"], null_aware=False
+            )
+            return b2
+
+        new_state, out, st_ovf, j_ovf = op.step(
+            states[slot],
+            deltas,
+            time,
+            ctx.join_caps[jsite],
+            exchange_fn if ctx.sharded else None,
+        )
+        upd = dict(upd)
+        upd[slot] = new_state
+        ovf = dict(ovf_box["d"])
+        for part, flag in st_ovf.items():
+            ovf[("state", slot, part)] = flag
+        ovf[("join", jsite)] = j_ovf
+        return out, upd, ovf
+
+    return run
+
+
+def _build_join_linear(expr: mir.Join, ctx: _RenderContext):
     """Linear join plan: left-fold binary JoinOp stages, each with both
     sides exchanged on the stage key (JoinPlan::Linear,
     compute-types/src/plan/join.rs:46; rendering linear_join.rs:204)."""
@@ -374,6 +509,163 @@ def _build_join(expr: mir.Join, ctx: _RenderContext):
     return run
 
 
+def _build_letrec(expr: mir.LetRec, ctx: _RenderContext):
+    """WITH MUTUALLY RECURSIVE: device-resident fixpoint iteration.
+
+    Analog of the reference's iterative scopes (compute/src/render.rs:887
+    ``render_recursive_plan``; differential ``Variable`` + PointStamp
+    timestamps). The TPU re-cast is a ``jax.lax.while_loop`` of semi-naive
+    (Jacobi) iterations — compiled once, running entirely on device:
+
+      iter 0: binding values see the step's real source deltas and empty
+              binding deltas;
+      iter k: values see empty source deltas and iteration k-1's binding
+              deltas; stateful operators inside the values carry their
+              arrangements through the loop (the converged state at outer
+              time t is the correct starting state for t+1, exactly the
+              effect of differential's full logical compaction).
+
+    Convergence = every binding's consolidated delta is empty (psum'd
+    across workers in SPMD mode, so the loop condition is mesh-uniform);
+    ``max_iters`` caps divergent or float-asymptotic recursions
+    (LetRecLimit / RETURN AT RECURSION LIMIT analog). The body sees the
+    per-step total (telescoped) binding deltas.
+
+    Known limitation (documented, as in SURVEY.md §7 hard part re:
+    determinism/recursion): retraction propagation uses derivation
+    counting, which matches the reference's semantics for monotone and
+    acyclic-derivation recursions; cyclic derivations with retractions
+    would need iteration-indexed state (differential's nested timestamps).
+    """
+    names = expr.names
+    schemas = expr.value_schemas
+    value_fns = [_build(v, ctx) for v in expr.values]
+    body_fn = _build(expr.body, ctx)
+    site = len(ctx.letrec_caps)
+    ctx.letrec_caps.append(ctx.default_letrec_cap)
+    max_iters = expr.max_iters if expr.max_iters is not None else 100_000
+
+    def run(states, inputs, time):
+        cap = ctx.letrec_caps[site]
+
+        def canon_states(states_l):
+            """Null-mask presence must be loop-invariant (pytree aux of
+            the while_loop carry): canonicalize every arrangement batch."""
+            out = []
+            for s in states_l:
+                if isinstance(s, tuple):
+                    out.append(
+                        tuple(
+                            Arrangement(
+                                a.batch.canonicalize_nulls(), a.key
+                            )
+                            for a in s
+                        )
+                    )
+                else:
+                    out.append(s)
+            return out
+
+        def run_values(states_l, it_inputs):
+            """One iteration: returns (new_states_list, deltas, ovf dict)."""
+            states_l = list(states_l)
+            ovf = {}
+            deltas = []
+            for i, fn in enumerate(value_fns):
+                d, upd, o = fn(states_l, it_inputs, time)
+                for k, v in upd.items():
+                    states_l[k] = v
+                ovf.update(o)
+                d = consolidate(d, include_time=False)
+                d, so = shrink(d, cap)
+                ovf[("lr", site, i)] = so
+                # Rebrand to the DECLARED binding schema (value exprs may
+                # produce equivalent columns under different names).
+                deltas.append(
+                    d.replace(schema=schemas[i]).canonicalize_nulls()
+                )
+            return canon_states(states_l), deltas, ovf
+
+        # Iteration 0: real inputs, empty binding deltas.
+        it0_inputs = dict(inputs)
+        for nm, sch in zip(names, schemas):
+            it0_inputs[nm] = Batch.empty(sch, cap)
+        states_l, deltas, ovf = run_values(list(states), it0_inputs)
+        accums = list(deltas)
+
+        ovf_keys = sorted(ovf.keys())
+
+        def pack(o):
+            if not ovf_keys:
+                return jnp.zeros((0,), jnp.bool_)
+            return jnp.stack(
+                [jnp.asarray(o[k]).astype(jnp.bool_).reshape(()) for k in ovf_keys]
+            )
+
+        empty_inputs = {
+            k: b.replace(count=jnp.zeros_like(b.count))
+            for k, b in inputs.items()
+        }
+
+        def cond(carry):
+            _, deltas_c, _, it, _ = carry
+            pending = jnp.asarray(0, jnp.int32)
+            for d in deltas_c:
+                pending = pending + d.count.reshape(()).astype(jnp.int32)
+            if ctx.sharded:
+                pending = jax.lax.psum(pending, ctx.axis_name)
+            return jnp.logical_and(it < max_iters, pending > 0)
+
+        def body(carry):
+            states_c, deltas_c, accums_c, it, ovf_c = carry
+            it_inputs = dict(empty_inputs)
+            for nm, d in zip(names, deltas_c):
+                it_inputs[nm] = d
+            states_n, new_deltas, o = run_values(list(states_c), it_inputs)
+            new_accums = []
+            for i, (a, d) in enumerate(zip(accums_c, new_deltas)):
+                m = consolidate(
+                    concat_batches([a, d]), include_time=False
+                )
+                m, so = shrink(m, cap)
+                o[("lr", site, i)] = jnp.logical_or(o[("lr", site, i)], so)
+                new_accums.append(m.canonicalize_nulls())
+            assert sorted(o.keys()) == ovf_keys, "ovf keys drifted"
+            return (
+                tuple(states_n),
+                tuple(new_deltas),
+                tuple(new_accums),
+                it + 1,
+                jnp.logical_or(ovf_c, pack(o)),
+            )
+
+        carry0 = (
+            tuple(states_l),
+            tuple(deltas),
+            tuple(accums),
+            jnp.asarray(1, jnp.int32),
+            pack(ovf),
+        )
+        states_f, _, accums_f, _, ovf_f = jax.lax.while_loop(
+            cond, body, carry0
+        )
+
+        # Body consumes real inputs + the per-step total binding deltas.
+        body_inputs = dict(inputs)
+        for nm, a in zip(names, accums_f):
+            body_inputs[nm] = a
+        states_l = list(states_f)
+        out, upd_b, ovf_b = body_fn(states_l, body_inputs, time)
+
+        upd = {i: s for i, s in enumerate(states_l)}
+        upd.update(upd_b)
+        ovf_out = {k: ovf_f[i] for i, k in enumerate(ovf_keys)}
+        ovf_out.update(ovf_b)
+        return out, upd, ovf_out
+
+    return run
+
+
 class _DataflowBase:
     """Shared host-side machinery: pipelined stepping, overflow-driven
     capacity growth with rollback/replay, peeks.
@@ -415,6 +707,9 @@ class _DataflowBase:
             self._remake_jit()
         elif key[0] == "x":
             self._ctx.slot_cap *= 2
+            self._remake_jit()
+        elif key[0] == "lr":
+            self._ctx.letrec_caps[key[1]] *= 2
             self._remake_jit()
         elif key[0] == "outd":
             self._ctx.out_delta_cap *= 2

@@ -188,6 +188,21 @@ class Batch:
             schema=self.schema,
         )
 
+    def canonicalize_nulls(self) -> "Batch":
+        """Make null-mask PRESENCE a function of the schema alone: nullable
+        columns get a materialized (possibly all-False) mask, non-nullable
+        columns get None. Needed wherever batches cross a fixed-structure
+        boundary (lax.while_loop carries: pytree aux must match)."""
+        nulls = []
+        for c, nl, col in zip(self.cols, self.nulls, self.schema.columns):
+            if col.nullable:
+                nulls.append(
+                    nl if nl is not None else jnp.zeros(c.shape[0], bool)
+                )
+            else:
+                nulls.append(None)
+        return self.replace(nulls=tuple(nulls))
+
     def replace(self, **kw) -> "Batch":
         d = dict(
             cols=self.cols,

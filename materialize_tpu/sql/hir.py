@@ -1,0 +1,394 @@
+"""HIR: high-level relational IR + AST→HIR planning (name resolution).
+
+Analog of the reference's ``sql`` crate planning layer: scope/column
+resolution (sql/src/plan/scope.rs), ``plan()`` producing HIR
+(sql/src/plan/hir.rs:109). HIR differs from MIR in that joins are binary
+with arbitrary ON predicates (incl. outer kinds) and scalar expressions
+may contain subqueries (Exists/ScalarSubquery) — lowering.py removes both
+(the decorrelation step, sql/src/plan/lowering.rs:188 analog).
+
+v1 scope: uncorrelated subqueries only (correlated ones raise — the
+reference's full decorrelation is future work); no outer-level columns in
+scalar exprs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..expr.relation import AggregateFunc
+from ..expr.scalar import (
+    BinaryFunc,
+    UnaryFunc,
+    VariadicFunc,
+)
+from ..repr.schema import Column, ColumnType, Schema
+from . import ast
+
+
+class PlanError(ValueError):
+    pass
+
+
+# -- HIR scalar expressions --------------------------------------------------
+
+
+class HirScalar:
+    pass
+
+
+@dataclass(frozen=True)
+class HColumn(HirScalar):
+    index: int  # position in the current relation
+
+
+@dataclass(frozen=True)
+class HLiteral(HirScalar):
+    value: object  # python scalar; None = NULL
+    ctype: ColumnType
+    scale: int = 0
+
+
+@dataclass(frozen=True)
+class HCallUnary(HirScalar):
+    func: str
+    expr: HirScalar
+
+
+@dataclass(frozen=True)
+class HCallBinary(HirScalar):
+    func: str
+    left: HirScalar
+    right: HirScalar
+
+
+@dataclass(frozen=True)
+class HCallVariadic(HirScalar):
+    func: str
+    exprs: tuple
+
+
+@dataclass(frozen=True)
+class HIf(HirScalar):
+    cond: HirScalar
+    then: HirScalar
+    els: HirScalar
+
+
+@dataclass(frozen=True)
+class HExists(HirScalar):
+    rel: "HirRelation"
+
+
+@dataclass(frozen=True)
+class HScalarSubquery(HirScalar):
+    rel: "HirRelation"
+
+
+@dataclass(frozen=True)
+class HInSubquery(HirScalar):
+    """x IN (SELECT ...): lowered to a semijoin (lowering.py)."""
+
+    expr: HirScalar
+    rel: "HirRelation"
+    negated: bool
+
+
+# -- HIR relation expressions ------------------------------------------------
+
+
+class HirRelation:
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class HGet(HirRelation):
+    name: str
+    _schema: Schema
+
+    def schema(self):
+        return self._schema
+
+
+@dataclass(frozen=True)
+class HConstant(HirRelation):
+    rows: tuple
+    _schema: Schema
+
+    def schema(self):
+        return self._schema
+
+
+@dataclass(frozen=True)
+class HProject(HirRelation):
+    input: HirRelation
+    outputs: tuple
+
+    def schema(self):
+        return self.input.schema().project(self.outputs)
+
+
+@dataclass(frozen=True)
+class HMap(HirRelation):
+    input: HirRelation
+    scalars: tuple  # (HirScalar, Column) — the planner types every expr
+
+    def schema(self):
+        return Schema(
+            tuple(self.input.schema().columns)
+            + tuple(c for _, c in self.scalars)
+        )
+
+
+@dataclass(frozen=True)
+class HFilter(HirRelation):
+    input: HirRelation
+    predicates: tuple
+
+    def schema(self):
+        return self.input.schema()
+
+
+@dataclass(frozen=True)
+class HJoin(HirRelation):
+    """Binary join with an ON predicate; kind in
+    inner/left/right/full/cross (hir.rs HirRelationExpr::Join)."""
+
+    left: HirRelation
+    right: HirRelation
+    on: tuple  # conjunction of HirScalar over concat(left, right) columns
+    kind: str
+
+    def schema(self):
+        lcols = list(self.left.schema().columns)
+        rcols = list(self.right.schema().columns)
+        if self.kind in ("left", "full"):
+            rcols = [Column(c.name, c.ctype, True, c.scale) for c in rcols]
+        if self.kind in ("right", "full"):
+            lcols = [Column(c.name, c.ctype, True, c.scale) for c in lcols]
+        return Schema(lcols + rcols)
+
+
+@dataclass(frozen=True)
+class HAggregate:
+    func: AggregateFunc
+    expr: HirScalar
+    distinct: bool
+    out: Column
+
+
+@dataclass(frozen=True)
+class HReduce(HirRelation):
+    input: HirRelation
+    group_key: tuple  # column indices
+    aggregates: tuple  # HAggregate
+
+    def schema(self):
+        in_s = self.input.schema()
+        return Schema(
+            [in_s[i] for i in self.group_key]
+            + [a.out for a in self.aggregates]
+        )
+
+
+@dataclass(frozen=True)
+class HDistinct(HirRelation):
+    input: HirRelation
+
+    def schema(self):
+        return self.input.schema()
+
+
+@dataclass(frozen=True)
+class HTopK(HirRelation):
+    input: HirRelation
+    group_key: tuple
+    order_by: tuple  # (col, desc, nulls_last)
+    limit: Optional[int]
+    offset: int
+
+    def schema(self):
+        return self.input.schema()
+
+
+@dataclass(frozen=True)
+class HNegate(HirRelation):
+    input: HirRelation
+
+    def schema(self):
+        return self.input.schema()
+
+
+@dataclass(frozen=True)
+class HThreshold(HirRelation):
+    input: HirRelation
+
+    def schema(self):
+        return self.input.schema()
+
+
+@dataclass(frozen=True)
+class HUnion(HirRelation):
+    inputs: tuple
+
+    def schema(self):
+        return self.inputs[0].schema()
+
+
+@dataclass(frozen=True)
+class HRename(HirRelation):
+    """Identity on rows; output columns renamed (alias application)."""
+
+    input: HirRelation
+    _schema: Schema
+
+    def schema(self):
+        return self._schema
+
+
+@dataclass(frozen=True)
+class HLet(HirRelation):
+    name: str
+    value: HirRelation
+    body: HirRelation
+
+    def schema(self):
+        return self.body.schema()
+
+
+@dataclass(frozen=True)
+class HLetRec(HirRelation):
+    names: tuple
+    values: tuple
+    value_schemas: tuple
+    body: HirRelation
+    max_iters: Optional[int]
+
+    def schema(self):
+        return self.body.schema()
+
+
+# -- scopes ------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ScopeItem:
+    table: Optional[str]  # alias the column is reachable under
+    name: str
+
+
+@dataclass
+class Scope:
+    """Column-name resolution for one relation (scope.rs analog)."""
+
+    items: list
+
+    def resolve(self, parts: tuple) -> int:
+        if len(parts) == 1:
+            hits = [
+                i for i, it in enumerate(self.items) if it.name == parts[0]
+            ]
+        elif len(parts) == 2:
+            hits = [
+                i
+                for i, it in enumerate(self.items)
+                if it.table == parts[0] and it.name == parts[1]
+            ]
+        else:
+            raise PlanError(f"too many name parts: {'.'.join(parts)}")
+        if not hits:
+            raise PlanError(f"unknown column {'.'.join(parts)!r}")
+        if len(hits) > 1:
+            raise PlanError(f"ambiguous column {'.'.join(parts)!r}")
+        return hits[0]
+
+    def concat(self, other: "Scope") -> "Scope":
+        return Scope(self.items + other.items)
+
+
+# -- catalog interface -------------------------------------------------------
+
+
+class CatalogInterface:
+    """What planning needs from the catalog: name -> relation schema."""
+
+    def resolve_item(self, name: str) -> Schema:
+        raise NotImplementedError
+
+
+_TYPE_NAMES = {
+    "int": ColumnType.INT64,
+    "integer": ColumnType.INT64,
+    "bigint": ColumnType.INT64,
+    "int4": ColumnType.INT32,
+    "int8": ColumnType.INT64,
+    "smallint": ColumnType.INT32,
+    "double precision": ColumnType.FLOAT64,
+    "double": ColumnType.FLOAT64,
+    "float": ColumnType.FLOAT64,
+    "float8": ColumnType.FLOAT64,
+    "real": ColumnType.FLOAT64,
+    "bool": ColumnType.BOOL,
+    "boolean": ColumnType.BOOL,
+    "text": ColumnType.STRING,
+    "varchar": ColumnType.STRING,
+    "string": ColumnType.STRING,
+    "date": ColumnType.DATE,
+    "timestamp": ColumnType.TIMESTAMP,
+    "numeric": ColumnType.DECIMAL,
+    "decimal": ColumnType.DECIMAL,
+}
+
+
+def type_from_name(name: str) -> ColumnType:
+    try:
+        return _TYPE_NAMES[name]
+    except KeyError:
+        raise PlanError(f"unknown type {name!r}") from None
+
+
+# -- typing HIR scalars ------------------------------------------------------
+
+from ..expr import scalar as mscalar
+
+
+def _to_mir_shape(e: HirScalar):
+    """Structural HIR->MIR scalar conversion for TYPING only (subqueries
+    unsupported here; lowering replaces them with columns first)."""
+    if isinstance(e, HColumn):
+        return mscalar.ColumnRef(e.index)
+    if isinstance(e, HLiteral):
+        return mscalar.Literal(e.value, e.ctype, e.scale)
+    if isinstance(e, HCallUnary):
+        return mscalar.CallUnary(e.func, _to_mir_shape(e.expr))
+    if isinstance(e, HCallBinary):
+        return mscalar.CallBinary(
+            e.func, _to_mir_shape(e.left), _to_mir_shape(e.right)
+        )
+    if isinstance(e, HCallVariadic):
+        return mscalar.CallVariadic(
+            e.func, [_to_mir_shape(x) for x in e.exprs]
+        )
+    if isinstance(e, HIf):
+        return mscalar.If(
+            _to_mir_shape(e.cond),
+            _to_mir_shape(e.then),
+            _to_mir_shape(e.els),
+        )
+    if isinstance(e, (HExists, HScalarSubquery)):
+        raise PlanError("subquery not lowered before typing")
+    raise NotImplementedError(type(e).__name__)
+
+
+def typ_of(e: HirScalar, schema: Schema) -> Column:
+    if isinstance(e, HScalarSubquery):
+        sub = e.rel.schema()
+        if sub.arity != 1:
+            raise PlanError("scalar subquery must return one column")
+        c = sub[0]
+        return Column(c.name, c.ctype, True, c.scale)
+    if isinstance(e, HExists):
+        return Column("exists", ColumnType.BOOL, False)
+    return _to_mir_shape(e).typ(schema)

@@ -1,0 +1,292 @@
+"""HIR → MIR lowering: subquery removal and outer-join expansion.
+
+Analog of the reference's ``sql/src/plan/lowering.rs:188`` (HIR→MIR with
+subquery decorrelation and outer-join lowering;
+doc/developer/101-query-compilation.md:51-62). v1 handles uncorrelated
+subqueries (correlated references fail name resolution upstream):
+
+- scalar subquery  -> cross join against the (single-row) subquery
+- x IN (SELECT..)  -> semijoin against DISTINCT(subquery)
+- EXISTS(..)       -> cross join against DISTINCT(project-to-zero-cols)
+- LEFT/RIGHT/FULL  -> inner join ∪ null-padded antijoin remainders
+  (the reference's outer-join lowering pattern)
+"""
+
+from __future__ import annotations
+
+from ..expr import relation as mir
+from ..expr import scalar as ms
+from ..expr.relation import AggregateExpr
+from ..repr.schema import Column, Schema
+from . import hir as h
+from .hir import PlanError
+
+
+def lower(rel: h.HirRelation) -> mir.RelationExpr:
+    if isinstance(rel, h.HGet):
+        return mir.Get(rel.name, rel._schema)
+    if isinstance(rel, h.HConstant):
+        return mir.Constant(rel.rows, rel._schema)
+    if isinstance(rel, h.HRename):
+        inner = lower(rel.input)
+        return _rename(inner, rel._schema)
+    if isinstance(rel, h.HProject):
+        return mir.Project(lower(rel.input), tuple(rel.outputs))
+    if isinstance(rel, h.HMap):
+        inner = lower(rel.input)
+        inner, scalars = _lower_scalars(
+            inner, [s for s, _ in rel.scalars]
+        )
+        base_arity = rel.input.schema().arity
+        cur = inner
+        if _arity(cur) != base_arity:
+            # subquery columns appended: map exprs then project them away
+            cur = mir.Map(cur, tuple(scalars))
+            n = len(scalars)
+            keep = list(range(base_arity)) + list(
+                range(_arity(cur) - n, _arity(cur))
+            )
+            return mir.Project(cur, tuple(keep))
+        return mir.Map(cur, tuple(scalars))
+    if isinstance(rel, h.HFilter):
+        return _lower_filter(rel)
+    if isinstance(rel, h.HJoin):
+        return _lower_join(rel)
+    if isinstance(rel, h.HReduce):
+        inner = lower(rel.input)
+        aggs = tuple(
+            AggregateExpr(a.func, _scalar(a.expr), a.distinct)
+            for a in rel.aggregates
+        )
+        return mir.Reduce(inner, tuple(rel.group_key), aggs)
+    if isinstance(rel, h.HDistinct):
+        inner = lower(rel.input)
+        return mir.Reduce(
+            inner, tuple(range(rel.input.schema().arity)), ()
+        )
+    if isinstance(rel, h.HTopK):
+        return mir.TopK(
+            lower(rel.input),
+            tuple(rel.group_key),
+            tuple(rel.order_by),
+            rel.limit,
+            rel.offset,
+        )
+    if isinstance(rel, h.HNegate):
+        return mir.Negate(lower(rel.input))
+    if isinstance(rel, h.HThreshold):
+        return mir.Threshold(lower(rel.input))
+    if isinstance(rel, h.HUnion):
+        return mir.Union(tuple(lower(i) for i in rel.inputs))
+    if isinstance(rel, h.HLet):
+        return mir.Let(rel.name, lower(rel.value), lower(rel.body))
+    if isinstance(rel, h.HLetRec):
+        return mir.LetRec(
+            tuple(rel.names),
+            tuple(lower(v) for v in rel.values),
+            tuple(rel.value_schemas),
+            lower(rel.body),
+            rel.max_iters,
+        )
+    raise NotImplementedError(type(rel).__name__)
+
+
+def _arity(m: mir.RelationExpr) -> int:
+    return m.schema().arity
+
+
+def _rename(inner: mir.RelationExpr, schema: Schema) -> mir.RelationExpr:
+    """MIR has no rename: Get/Constant carry schemas, everything else
+    derives names structurally. A no-op Project keeps the tree shape and
+    downstream code reads names off the HIR side."""
+    if isinstance(inner, mir.Get):
+        return mir.Get(inner.name, schema)
+    if isinstance(inner, mir.Constant):
+        return mir.Constant(inner.rows, schema)
+    return inner
+
+
+# -- scalar lowering with subquery extraction --------------------------------
+
+
+def _scalar(e: h.HirScalar) -> ms.ScalarExpr:
+    """Subquery-free HIR scalar -> MIR scalar."""
+    return h._to_mir_shape(e)
+
+
+def _lower_scalars(cur: mir.RelationExpr, exprs):
+    """Lower scalars that may contain HScalarSubquery: each subquery is
+    cross-joined once and replaced by a column reference. Returns
+    (new_relation, mir scalar exprs referring to it)."""
+
+    def walk(e, appended):
+        if isinstance(e, h.HScalarSubquery):
+            sub = lower(e.rel)
+            if sub.schema().arity != 1:
+                raise PlanError("scalar subquery must return one column")
+            idx = appended["arity"]
+            appended["joins"].append(sub)
+            appended["arity"] += 1
+            return ms.ColumnRef(idx)
+        if isinstance(e, h.HColumn):
+            return ms.ColumnRef(e.index)
+        if isinstance(e, h.HLiteral):
+            return ms.Literal(e.value, e.ctype, e.scale)
+        if isinstance(e, h.HCallUnary):
+            return ms.CallUnary(e.func, walk(e.expr, appended))
+        if isinstance(e, h.HCallBinary):
+            return ms.CallBinary(
+                e.func, walk(e.left, appended), walk(e.right, appended)
+            )
+        if isinstance(e, h.HCallVariadic):
+            return ms.CallVariadic(
+                e.func, [walk(x, appended) for x in e.exprs]
+            )
+        if isinstance(e, h.HIf):
+            return ms.If(
+                walk(e.cond, appended),
+                walk(e.then, appended),
+                walk(e.els, appended),
+            )
+        if isinstance(e, (h.HExists, h.HInSubquery)):
+            raise PlanError(
+                "EXISTS/IN subqueries are supported as top-level WHERE "
+                "conjuncts only"
+            )
+        raise NotImplementedError(type(e).__name__)
+
+    base = _arity(cur)
+    appended = {"arity": base, "joins": []}
+    out = [walk(e, appended) for e in exprs]
+    for sub in appended["joins"]:
+        cur = mir.Join((cur, sub), equivalences=())
+    # References were assigned positions base..base+k in append order —
+    # consistent with the join concatenation order.
+    return cur, out
+
+
+def _lower_filter(rel: h.HFilter) -> mir.RelationExpr:
+    cur = lower(rel.input)
+    base = _arity(cur)
+    plain: list = []
+    for p in rel.predicates:
+        if isinstance(p, h.HInSubquery):
+            cur = _semijoin(cur, p, base)
+            continue
+        if isinstance(p, h.HExists):
+            sub = lower(p.rel)
+            flag = mir.Reduce(
+                mir.Project(sub, ()), (), ()
+            )  # zero-col distinct: one row iff sub nonempty
+            cur = mir.Join((cur, flag), equivalences=())
+            continue
+        plain.append(p)
+    if plain:
+        cur, preds = _lower_scalars(cur, plain)
+    else:
+        preds = []
+    if _arity(cur) != base:
+        cur = mir.Filter(cur, tuple(preds)) if preds else cur
+        return mir.Project(cur, tuple(range(base)))
+    return mir.Filter(cur, tuple(preds)) if preds else cur
+
+
+def _semijoin(cur, p: h.HInSubquery, base: int):
+    """x IN (sub): join against DISTINCT(sub) on x; NOT IN via threshold
+    antijoin. x must be a column (pre-mapped by the planner if complex)."""
+    sub = lower(p.rel)
+    if sub.schema().arity != 1:
+        raise PlanError("IN subquery must return one column")
+    d = mir.Reduce(sub, (0,), ())  # distinct values
+    if not isinstance(p.expr, h.HColumn):
+        raise PlanError("IN subquery left side must be a column (v1)")
+    xcol = p.expr.index
+    semi = mir.Project(
+        mir.Join(
+            (cur, d),
+            equivalences=((ms.ColumnRef(xcol), ms.ColumnRef(base)),),
+        ),
+        tuple(range(base)),
+    )
+    if not p.negated:
+        return semi
+    return mir.Threshold(mir.Union((cur, mir.Negate(semi))))
+
+
+# -- join lowering -----------------------------------------------------------
+
+
+def _split_on(on, l_arity: int, r_arity: int):
+    """Partition ON conjuncts into column-equivalence pairs and residual
+    predicates (over the concatenated columns)."""
+    equivs: list = []
+    residual: list = []
+    for c in on:
+        if (
+            isinstance(c, h.HCallBinary)
+            and c.func == ms.BinaryFunc.EQ
+            and isinstance(c.left, h.HColumn)
+            and isinstance(c.right, h.HColumn)
+        ):
+            a, b = c.left.index, c.right.index
+            if (a < l_arity) != (b < l_arity):
+                equivs.append(
+                    (ms.ColumnRef(min(a, b)), ms.ColumnRef(max(a, b)))
+                )
+                continue
+        residual.append(c)
+    return equivs, residual
+
+
+def _lower_join(rel: h.HJoin) -> mir.RelationExpr:
+    left = lower(rel.left)
+    right = lower(rel.right)
+    la, ra = _arity(left), _arity(right)
+    equivs, residual = _split_on(rel.on, la, ra)
+    inner = mir.Join((left, right), equivalences=tuple(equivs))
+    if residual:
+        inner = mir.Filter(
+            inner, tuple(_scalar(c) for c in residual)
+        )
+    if rel.kind in ("inner", "cross"):
+        return inner
+    out_schema = rel.schema()
+
+    def pad(unmatched, null_ctypes_cols, nulls_first: bool):
+        """Append (or prepend, via projection) NULL columns."""
+        scalars = tuple(
+            ms.Literal(None, c.ctype, c.scale) for c in null_ctypes_cols
+        )
+        m = mir.Map(unmatched, scalars)
+        if not nulls_first:
+            return m
+        n_u = _arity(unmatched)
+        n_n = len(null_ctypes_cols)
+        perm = tuple(range(n_u, n_u + n_n)) + tuple(range(n_u))
+        return mir.Project(m, perm)
+
+    def antijoin(side, side_arity, inner_proj):
+        """Rows of `side` with no match: side - (side ⋉ matched-rows)."""
+        matched = mir.Reduce(
+            mir.Project(inner, inner_proj), tuple(range(side_arity)), ()
+        )
+        semi = mir.Project(
+            mir.Join(
+                (side, matched),
+                equivalences=tuple(
+                    (ms.ColumnRef(i), ms.ColumnRef(side_arity + i))
+                    for i in range(side_arity)
+                ),
+            ),
+            tuple(range(side_arity)),
+        )
+        return mir.Threshold(mir.Union((side, mir.Negate(semi))))
+
+    parts = [inner]
+    if rel.kind in ("left", "full"):
+        lu = antijoin(left, la, tuple(range(la)))
+        parts.append(pad(lu, out_schema.columns[la:], nulls_first=False))
+    if rel.kind in ("right", "full"):
+        ru = antijoin(right, ra, tuple(range(la, la + ra)))
+        parts.append(pad(ru, out_schema.columns[:la], nulls_first=True))
+    return mir.Union(tuple(parts))

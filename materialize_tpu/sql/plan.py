@@ -1,0 +1,185 @@
+"""Statement planning: AST → Plan (the adapter's unit of sequencing).
+
+Analog of the reference's ``plan()`` dispatch (sql/src/plan/statement.rs:288)
+producing per-statement ``Plan`` variants (sql/src/plan.rs:133), and the
+EXPLAIN stage pipeline (EXPLAIN RAW|DECORRELATED|OPTIMIZED|PHYSICAL PLAN,
+sql-parser statement.rs ExplainStage).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..expr import relation as mir
+from ..repr.schema import Schema
+from . import ast
+from .hir import CatalogInterface, HirRelation, PlanError
+from .lowering import lower
+from .parser import parse_statement
+from .plan_query import QueryPlanner
+
+
+class Plan:
+    pass
+
+
+@dataclass
+class SelectPlan(Plan):
+    expr: mir.RelationExpr
+    column_names: tuple
+
+
+@dataclass
+class CreateViewPlan(Plan):
+    name: str
+    expr: mir.RelationExpr
+    column_names: tuple
+    materialized: bool
+    or_replace: bool
+
+
+@dataclass
+class CreateIndexPlan(Plan):
+    name: str
+    on: str
+
+
+@dataclass
+class CreateSourcePlan(Plan):
+    name: str
+    generator: str
+    options: dict
+
+
+@dataclass
+class DropPlan(Plan):
+    kind: str
+    name: str
+    if_exists: bool
+
+
+@dataclass
+class SubscribePlan(Plan):
+    expr: mir.RelationExpr
+    column_names: tuple
+
+
+@dataclass
+class ExplainPlan(Plan):
+    stage: str
+    text: str
+
+
+@dataclass
+class ShowPlan(Plan):
+    kind: str
+
+
+def plan_statement(sql_or_stmt, catalog: CatalogInterface) -> Plan:
+    stmt = (
+        parse_statement(sql_or_stmt)
+        if isinstance(sql_or_stmt, str)
+        else sql_or_stmt
+    )
+    return _plan(stmt, catalog)
+
+
+def _plan(stmt: ast.Statement, catalog: CatalogInterface) -> Plan:
+    qp = QueryPlanner(catalog)
+    if isinstance(stmt, ast.SelectStatement):
+        hir_rel, scope = qp.plan_query(stmt.query)
+        return SelectPlan(
+            lower(hir_rel), tuple(it.name for it in scope.items)
+        )
+    if isinstance(stmt, ast.CreateView):
+        hir_rel, scope = qp.plan_query(stmt.query)
+        return CreateViewPlan(
+            stmt.name,
+            lower(hir_rel),
+            tuple(it.name for it in scope.items),
+            stmt.materialized,
+            stmt.or_replace,
+        )
+    if isinstance(stmt, ast.CreateIndex):
+        return CreateIndexPlan(
+            stmt.name or f"{stmt.on}_primary_idx", stmt.on
+        )
+    if isinstance(stmt, ast.CreateSource):
+        return CreateSourcePlan(stmt.name, stmt.generator, stmt.options)
+    if isinstance(stmt, ast.DropObject):
+        return DropPlan(stmt.kind, stmt.name, stmt.if_exists)
+    if isinstance(stmt, ast.Subscribe):
+        hir_rel, scope = qp.plan_query(stmt.query)
+        return SubscribePlan(
+            lower(hir_rel), tuple(it.name for it in scope.items)
+        )
+    if isinstance(stmt, ast.Explain):
+        return _explain(stmt, catalog)
+    if isinstance(stmt, ast.ShowObjects):
+        return ShowPlan(stmt.kind)
+    raise PlanError(f"cannot plan {type(stmt).__name__}")
+
+
+def _explain(stmt: ast.Explain, catalog: CatalogInterface) -> Plan:
+    inner = stmt.statement
+    if isinstance(inner, ast.SelectStatement):
+        query = inner.query
+    elif isinstance(inner, ast.CreateView):
+        query = inner.query
+    else:
+        raise PlanError("EXPLAIN supports queries and views")
+    if stmt.stage == "raw":
+        return ExplainPlan("raw", _fmt(query))
+    qp = QueryPlanner(catalog)
+    hir_rel, _ = qp.plan_query(query)
+    if stmt.stage == "decorrelated":
+        return ExplainPlan("decorrelated", explain_mir(lower(hir_rel)))
+    m = lower(hir_rel)
+    if stmt.stage in ("optimized", "physical"):
+        from ..transform.optimizer import optimize
+
+        m = optimize(m)
+    return ExplainPlan(stmt.stage, explain_mir(m))
+
+
+def _fmt(node, indent: int = 0) -> str:
+    pad = "  " * indent
+    name = type(node).__name__
+    return f"{pad}{name}"
+
+
+def explain_mir(expr: mir.RelationExpr, indent: int = 0) -> str:
+    """Readable MIR tree, one operator per line (EXPLAIN output;
+    reference sql-pretty / explain API)."""
+    pad = "  " * indent
+    name = type(expr).__name__
+    detail = ""
+    if isinstance(expr, mir.Get):
+        detail = f" {expr.name}"
+    elif isinstance(expr, mir.Project):
+        detail = f" outputs={list(expr.outputs)}"
+    elif isinstance(expr, mir.Filter):
+        detail = f" predicates={len(expr.predicates)}"
+    elif isinstance(expr, mir.Map):
+        detail = f" scalars={len(expr.scalars)}"
+    elif isinstance(expr, mir.Join):
+        detail = (
+            f" implementation={expr.implementation}"
+            f" equivalences={len(expr.equivalences)}"
+        )
+    elif isinstance(expr, mir.Reduce):
+        detail = (
+            f" group_key={list(expr.group_key)}"
+            f" aggregates={[a.func.value for a in expr.aggregates]}"
+        )
+    elif isinstance(expr, mir.TopK):
+        detail = f" group_key={list(expr.group_key)} limit={expr.limit}"
+    elif isinstance(expr, mir.LetRec):
+        detail = f" bindings={list(expr.names)}"
+    elif isinstance(expr, mir.Let):
+        detail = f" name={expr.name}"
+    lines = [f"{pad}{name}{detail}"]
+    for c in expr.children():
+        lines.append(explain_mir(c, indent + 1))
+    return "\n".join(lines)

@@ -1,0 +1,636 @@
+"""AST → HIR query planning: scopes, name resolution, aggregate planning.
+
+Analog of the reference's ``plan_query``/``plan_select`` path
+(sql/src/plan/query.rs, dispatched from sql/src/plan/statement.rs:288):
+FROM clause folding with binary joins, WHERE, GROUP BY/HAVING with
+aggregate extraction, SELECT item planning, DISTINCT, set operations,
+CTEs (Let) and WITH MUTUALLY RECURSIVE (LetRec), ORDER BY/LIMIT as TopK.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..expr.relation import AggregateFunc
+from ..expr.scalar import BinaryFunc, UnaryFunc, VariadicFunc
+from ..repr.schema import GLOBAL_DICT, Column, ColumnType, Schema
+from . import ast
+from .hir import (
+    CatalogInterface,
+    HAggregate,
+    HCallBinary,
+    HCallUnary,
+    HCallVariadic,
+    HColumn,
+    HConstant,
+    HDistinct,
+    HExists,
+    HFilter,
+    HGet,
+    HIf,
+    HInSubquery,
+    HJoin,
+    HLet,
+    HLetRec,
+    HLiteral,
+    HMap,
+    HProject,
+    HReduce,
+    HRename,
+    HScalarSubquery,
+    HTopK,
+    HUnion,
+    HirRelation,
+    PlanError,
+    Scope,
+    ScopeItem,
+    type_from_name,
+    typ_of,
+)
+
+_BINOPS = {
+    "+": BinaryFunc.ADD,
+    "-": BinaryFunc.SUB,
+    "*": BinaryFunc.MUL,
+    "/": BinaryFunc.DIV,
+    "%": BinaryFunc.MOD,
+    "=": BinaryFunc.EQ,
+    "<>": BinaryFunc.NEQ,
+    "<": BinaryFunc.LT,
+    "<=": BinaryFunc.LTE,
+    ">": BinaryFunc.GT,
+    ">=": BinaryFunc.GTE,
+}
+
+_AGG_FUNCS = {"count", "sum", "min", "max", "avg"}
+
+
+def _number_literal(text: str) -> HLiteral:
+    if "." in text:
+        frac = text.split(".", 1)[1]
+        scale = len(frac)
+        return HLiteral(
+            int(text.replace(".", "")), ColumnType.DECIMAL, scale
+        )
+    return HLiteral(int(text), ColumnType.INT64)
+
+
+class QueryPlanner:
+    def __init__(self, catalog: CatalogInterface):
+        self.catalog = catalog
+        self._ctes: dict[str, Schema] = {}
+
+    # -- queries ---------------------------------------------------------
+    def plan_query(self, q: ast.Query) -> tuple[HirRelation, Scope]:
+        saved = dict(self._ctes)
+        try:
+            if q.mutually_recursive:
+                rel, scope = self._plan_wmr(q)
+            else:
+                lets = []
+                for cte in q.ctes:
+                    value, vscope = self.plan_query(cte.query)
+                    vschema = value.schema()
+                    if cte.columns:
+                        names = [c[0] for c in cte.columns]
+                        if len(names) != vschema.arity:
+                            raise PlanError(
+                                f"cte {cte.name}: {len(names)} aliases for "
+                                f"{vschema.arity} columns"
+                            )
+                        vschema = vschema.rename(names)
+                        value = _rebrand(value, vschema)
+                    self._ctes[cte.name] = vschema
+                    lets.append((cte.name, value))
+                rel, scope = self._plan_set_expr(q.body)
+                for name, value in reversed(lets):
+                    rel = HLet(name, value, rel)
+            rel, scope = self._apply_finishing(rel, scope, q)
+            return rel, scope
+        finally:
+            self._ctes = saved
+
+    def _apply_finishing(self, rel, scope, q: ast.Query):
+        if q.order_by:
+            order = []
+            for ob in q.order_by:
+                if isinstance(ob.expr, ast.NumberLit):
+                    idx = int(ob.expr.text) - 1  # ORDER BY 2
+                else:
+                    idx = scope.resolve(_ident_parts(ob.expr))
+                nulls_last = (
+                    ob.nulls_last
+                    if ob.nulls_last is not None
+                    else not ob.desc  # PG default: ASC->LAST, DESC->FIRST
+                )
+                order.append((idx, ob.desc, nulls_last))
+            if q.limit is not None or q.offset:
+                rel = HTopK(rel, (), tuple(order), q.limit, q.offset)
+            # bare ORDER BY on an unordered collection is a no-op (the
+            # peek finishing layer re-sorts; reference RowSetFinishing)
+        elif q.limit is not None or q.offset:
+            rel = HTopK(rel, (), (), q.limit, q.offset)
+        return rel, scope
+
+    def _plan_wmr(self, q: ast.Query):
+        names, value_schemas = [], []
+        for cte in q.ctes:
+            if not cte.columns or any(t is None for _, t in cte.columns):
+                raise PlanError(
+                    "WITH MUTUALLY RECURSIVE bindings need (name type, ...)"
+                )
+            cols = [
+                Column(n, type_from_name(t), True) for n, t in cte.columns
+            ]
+            sch = Schema(cols)
+            names.append(cte.name)
+            value_schemas.append(sch)
+            self._ctes[cte.name] = sch
+        values = []
+        for cte, sch in zip(q.ctes, value_schemas):
+            v, _ = self.plan_query(cte.query)
+            vs = v.schema()
+            if vs.arity != sch.arity:
+                raise PlanError(
+                    f"binding {cte.name}: arity {vs.arity} != declared "
+                    f"{sch.arity}"
+                )
+            values.append(_rebrand(v, sch))
+        body, scope = self._plan_set_expr(q.body)
+        return (
+            HLetRec(
+                tuple(names), tuple(values), tuple(value_schemas), body,
+                q.recursion_limit,
+            ),
+            scope,
+        )
+
+    def _plan_set_expr(self, se: ast.SetExpr):
+        if isinstance(se, ast.SelectExpr):
+            return self._plan_select(se.select)
+        if isinstance(se, ast.SetOp):
+            left, lscope = self._plan_set_expr(se.left)
+            right, _ = self._plan_set_expr(se.right)
+            ls, rs = left.schema(), right.schema()
+            if ls.arity != rs.arity:
+                raise PlanError("set operation arity mismatch")
+            if se.op == "union":
+                rel = HUnion((left, right))
+                if not se.all:
+                    rel = HDistinct(rel)
+                return rel, lscope
+            if se.op == "except":
+                if not se.all:
+                    left, right = HDistinct(left), HDistinct(right)
+                from .hir import HNegate, HThreshold
+
+                return HThreshold(HUnion((left, HNegate(right)))), lscope
+            if se.op == "intersect":
+                from .hir import HNegate, HThreshold
+
+                if not se.all:
+                    left, right = HDistinct(left), HDistinct(right)
+                # a ∩ b = a - (a - b)
+                a_minus_b = HThreshold(HUnion((left, HNegate(right))))
+                return (
+                    HThreshold(HUnion((left, HNegate(a_minus_b)))),
+                    lscope,
+                )
+        raise NotImplementedError(type(se).__name__)
+
+    # -- FROM ------------------------------------------------------------
+    def _plan_table_factor(self, f: ast.TableFactor):
+        if isinstance(f, ast.TableName):
+            if f.name in self._ctes:
+                sch = self._ctes[f.name]
+            else:
+                sch = self.catalog.resolve_item(f.name)
+            rel = HGet(f.name, sch)
+            alias = f.alias.name if f.alias else f.name
+            names = (
+                list(f.alias.columns)
+                if f.alias and f.alias.columns
+                else list(sch.names)
+            )
+            scope = Scope([ScopeItem(alias, n) for n in names])
+            return rel, scope
+        if isinstance(f, ast.DerivedTable):
+            rel, inner_scope = self.plan_query(f.query)
+            sch = rel.schema()
+            if f.alias is None:
+                raise PlanError("subquery in FROM requires an alias")
+            names = (
+                list(f.alias.columns)
+                if f.alias.columns
+                else [it.name for it in inner_scope.items]
+            )
+            scope = Scope([ScopeItem(f.alias.name, n) for n in names])
+            return rel, scope
+        raise NotImplementedError(type(f).__name__)
+
+    def _plan_from(self, from_: tuple):
+        rel, scope = None, None
+        for item in from_:
+            r, s = self._plan_table_factor(item.factor)
+            for jc in item.joins:
+                jr, js = self._plan_table_factor(jc.factor)
+                combined = s.concat(js)
+                on: list = []
+                if jc.using:
+                    larity = len(s.items)
+                    for name in jc.using:
+                        li = s.resolve((name,))
+                        ri = js.resolve((name,))
+                        on.append(
+                            HCallBinary(
+                                BinaryFunc.EQ,
+                                HColumn(li),
+                                HColumn(larity + ri),
+                            )
+                        )
+                elif jc.on is not None:
+                    on = self._conjuncts(jc.on, combined)
+                r = HJoin(r, jr, tuple(on), jc.kind)
+                s = combined
+            if rel is None:
+                rel, scope = r, s
+            else:
+                rel = HJoin(rel, r, (), "cross")
+                scope = scope.concat(s)
+        return rel, scope
+
+    def _conjuncts(self, e: ast.Expr, scope: Scope) -> list:
+        if isinstance(e, ast.BinaryOp) and e.op == "and":
+            return self._conjuncts(e.left, scope) + self._conjuncts(
+                e.right, scope
+            )
+        return [self.plan_expr(e, scope)]
+
+    # -- SELECT ----------------------------------------------------------
+    def _plan_select(self, sel: ast.Select):
+        if sel.from_:
+            rel, scope = self._plan_from(sel.from_)
+        else:
+            rel = HConstant(((tuple(), 1),), Schema([]))
+            scope = Scope([])
+
+        if sel.where is not None:
+            rel = HFilter(rel, tuple(self._conjuncts(sel.where, scope)))
+
+        # Expand stars and name outputs.
+        items: list[tuple[ast.Expr, str]] = []
+        for it in sel.items:
+            if isinstance(it.expr, ast.Star):
+                for i, sc in enumerate(scope.items):
+                    if it.expr.qualifier and sc.table != it.expr.qualifier:
+                        continue
+                    items.append((ast.Ident((sc.table, sc.name)), sc.name))
+            else:
+                items.append((it.expr, it.alias or _default_name(it.expr)))
+
+        has_aggs = bool(sel.group_by) or any(
+            _contains_agg(e) for e, _ in items
+        ) or (sel.having is not None and _contains_agg(sel.having))
+
+        if has_aggs:
+            rel, scope, items, having = self._plan_aggregation(
+                rel, scope, sel, items
+            )
+            if having is not None:
+                rel = HFilter(rel, (having,))
+        elif sel.having is not None:
+            raise PlanError("HAVING without aggregation")
+
+        # Map select expressions, project to output columns.
+        schema = rel.schema()
+        scalars, outputs = [], []
+        for e, name in items:
+            h = self.plan_expr(e, scope)
+            if isinstance(h, HColumn):
+                outputs.append(h.index)
+            else:
+                c = typ_of(h, schema_with(schema, scalars))
+                scalars.append((h, Column(name, c.ctype, c.nullable, c.scale)))
+                outputs.append(schema.arity + len(scalars) - 1)
+        if scalars:
+            rel = HMap(rel, tuple(scalars))
+        rel = HProject(rel, tuple(outputs))
+        out_scope = Scope([ScopeItem(None, n) for _, n in items])
+        # Rename projected columns to their aliases.
+        rel = _rebrand(rel, rel.schema().rename([n for _, n in items]))
+        if sel.distinct:
+            rel = HDistinct(rel)
+        return rel, out_scope
+
+    def _plan_aggregation(self, rel, scope, sel: ast.Select, items):
+        schema = rel.schema()
+        # 1. group key expressions -> map non-column exprs first
+        key_sources: list[ast.Expr] = list(sel.group_by)
+        pre_scalars: list = []
+        key_indices: list[int] = []
+        for ge in key_sources:
+            if isinstance(ge, ast.NumberLit):  # GROUP BY 1
+                e, _ = items[int(ge.text) - 1]
+            else:
+                e = ge
+            h = self.plan_expr(e, scope)
+            if isinstance(h, HColumn):
+                key_indices.append(h.index)
+            else:
+                c = typ_of(h, schema_with(schema, pre_scalars))
+                pre_scalars.append((h, c))
+                key_indices.append(schema.arity + len(pre_scalars) - 1)
+        if pre_scalars:
+            rel = HMap(rel, tuple(pre_scalars))
+            schema = rel.schema()
+
+        # 2. collect aggregate calls from items + having
+        aggs: list[HAggregate] = []
+
+        def plan_agg(fc: ast.FuncCall) -> list:
+            """Returns [(kind, agg_index)] — avg yields sum+count."""
+            name = fc.name
+            if fc.star or (name == "count" and not fc.args):
+                inner = HLiteral(True, ColumnType.BOOL)
+            else:
+                inner = self.plan_expr(fc.args[0], scope)
+            ityp = typ_of(inner, schema)
+            if fc.distinct:
+                raise NotImplementedError("DISTINCT aggregates")
+            if name == "count":
+                func, out = AggregateFunc.COUNT, Column(
+                    "count", ColumnType.INT64, False
+                )
+                aggs.append(HAggregate(func, inner, False, out))
+                return [len(aggs) - 1]
+            if name == "sum":
+                if ityp.ctype is ColumnType.FLOAT64:
+                    func = AggregateFunc.SUM_FLOAT
+                    out = Column("sum", ColumnType.FLOAT64, True)
+                else:
+                    func = AggregateFunc.SUM_INT
+                    out = Column("sum", ityp.ctype, True, ityp.scale)
+                aggs.append(HAggregate(func, inner, False, out))
+                return [len(aggs) - 1]
+            if name in ("min", "max"):
+                func = (
+                    AggregateFunc.MIN if name == "min" else AggregateFunc.MAX
+                )
+                out = Column(name, ityp.ctype, True, ityp.scale)
+                aggs.append(HAggregate(func, inner, False, out))
+                return [len(aggs) - 1]
+            if name == "avg":
+                s = plan_agg(ast.FuncCall("sum", fc.args))
+                c = plan_agg(ast.FuncCall("count", fc.args))
+                return s + c
+            raise PlanError(f"unknown aggregate {name}")
+
+        n_key = len(key_indices)
+        agg_refs: dict[int, list] = {}
+
+        def rewrite(e: ast.Expr):
+            """Replace aggregate calls with post-reduce column refs."""
+            if isinstance(e, ast.FuncCall) and (
+                e.name in _AGG_FUNCS or e.star
+            ):
+                key = id(e)
+                if key not in agg_refs:
+                    agg_refs[key] = plan_agg(e)
+                idxs = agg_refs[key]
+                if len(idxs) == 1:
+                    return _PostAggColumn(n_key + idxs[0])
+                # avg = sum / count
+                return ast.BinaryOp(
+                    "/",
+                    _PostAggColumn(n_key + idxs[0]),
+                    _PostAggColumn(n_key + idxs[1]),
+                )
+            if isinstance(e, ast.BinaryOp):
+                return ast.BinaryOp(e.op, rewrite(e.left), rewrite(e.right))
+            if isinstance(e, ast.UnaryOp):
+                return ast.UnaryOp(e.op, rewrite(e.expr))
+            if isinstance(e, ast.Cast):
+                return ast.Cast(rewrite(e.expr), e.to_type)
+            if isinstance(e, ast.FuncCall):
+                return ast.FuncCall(
+                    e.name, tuple(rewrite(a) for a in e.args), e.distinct
+                )
+            return e
+
+        new_items = []
+        for e, name in items:
+            re_ = rewrite(e)
+            new_items.append((re_, name))
+        having = None
+        if sel.having is not None:
+            having_ast = rewrite(sel.having)
+            rel2 = HReduce(rel, tuple(key_indices), tuple(aggs))
+            post_scope = self._post_agg_scope(scope, key_indices, aggs)
+            having = self.plan_expr(having_ast, post_scope)
+            return rel2, post_scope, new_items, having
+        rel2 = HReduce(rel, tuple(key_indices), tuple(aggs))
+        post_scope = self._post_agg_scope(scope, key_indices, aggs)
+        return rel2, post_scope, new_items, None
+
+    def _post_agg_scope(self, scope, key_indices, aggs):
+        items = [
+            ScopeItem(scope.items[i].table, scope.items[i].name)
+            for i in key_indices
+        ]
+        items += [ScopeItem(None, a.out.name) for a in aggs]
+        return Scope(items)
+
+    # -- scalar expressions ----------------------------------------------
+    def plan_expr(self, e: ast.Expr, scope: Scope):
+        if isinstance(e, _PostAggColumn):
+            return HColumn(e.index)
+        if isinstance(e, ast.Ident):
+            return HColumn(scope.resolve(e.parts))
+        if isinstance(e, ast.NumberLit):
+            return _number_literal(e.text)
+        if isinstance(e, ast.StringLit):
+            return HLiteral(
+                GLOBAL_DICT.encode(e.value), ColumnType.STRING
+            )
+        if isinstance(e, ast.BoolLit):
+            return HLiteral(e.value, ColumnType.BOOL)
+        if isinstance(e, ast.NullLit):
+            return HLiteral(None, ColumnType.INT64)
+        if isinstance(e, ast.BinaryOp):
+            if e.op == "and":
+                return HCallVariadic(
+                    VariadicFunc.AND,
+                    (
+                        self.plan_expr(e.left, scope),
+                        self.plan_expr(e.right, scope),
+                    ),
+                )
+            if e.op == "or":
+                return HCallVariadic(
+                    VariadicFunc.OR,
+                    (
+                        self.plan_expr(e.left, scope),
+                        self.plan_expr(e.right, scope),
+                    ),
+                )
+            if e.op in _BINOPS:
+                return HCallBinary(
+                    _BINOPS[e.op],
+                    self.plan_expr(e.left, scope),
+                    self.plan_expr(e.right, scope),
+                )
+            raise PlanError(f"unsupported operator {e.op!r}")
+        if isinstance(e, ast.UnaryOp):
+            inner = self.plan_expr(e.expr, scope)
+            if e.op == "-":
+                return HCallUnary(UnaryFunc.NEG, inner)
+            if e.op == "not":
+                return HCallUnary(UnaryFunc.NOT, inner)
+        if isinstance(e, ast.IsNull):
+            inner = HCallUnary(
+                UnaryFunc.IS_NULL, self.plan_expr(e.expr, scope)
+            )
+            return (
+                HCallUnary(UnaryFunc.NOT, inner) if e.negated else inner
+            )
+        if isinstance(e, ast.Between):
+            x = self.plan_expr(e.expr, scope)
+            lo = self.plan_expr(e.low, scope)
+            hi = self.plan_expr(e.high, scope)
+            within = HCallVariadic(
+                VariadicFunc.AND,
+                (
+                    HCallBinary(BinaryFunc.GTE, x, lo),
+                    HCallBinary(BinaryFunc.LTE, x, hi),
+                ),
+            )
+            return (
+                HCallUnary(UnaryFunc.NOT, within) if e.negated else within
+            )
+        if isinstance(e, ast.InList):
+            x = self.plan_expr(e.expr, scope)
+            eqs = tuple(
+                HCallBinary(BinaryFunc.EQ, x, self.plan_expr(i, scope))
+                for i in e.items
+            )
+            anyeq = HCallVariadic(VariadicFunc.OR, eqs)
+            return HCallUnary(UnaryFunc.NOT, anyeq) if e.negated else anyeq
+        if isinstance(e, ast.Case):
+            if e.operand is not None:
+                op = self.plan_expr(e.operand, scope)
+                whens = [
+                    (
+                        HCallBinary(
+                            BinaryFunc.EQ, op, self.plan_expr(c, scope)
+                        ),
+                        self.plan_expr(r, scope),
+                    )
+                    for c, r in e.whens
+                ]
+            else:
+                whens = [
+                    (self.plan_expr(c, scope), self.plan_expr(r, scope))
+                    for c, r in e.whens
+                ]
+            els = (
+                self.plan_expr(e.else_, scope)
+                if e.else_ is not None
+                else HLiteral(None, ColumnType.INT64)
+            )
+            out = els
+            for cond, res in reversed(whens):
+                out = HIf(cond, res, out)
+            return out
+        if isinstance(e, ast.Cast):
+            inner = self.plan_expr(e.expr, scope)
+            ty = type_from_name(e.to_type)
+            if ty is ColumnType.INT64:
+                return HCallUnary(UnaryFunc.CAST_INT64, inner)
+            if ty is ColumnType.FLOAT64:
+                return HCallUnary(UnaryFunc.CAST_FLOAT64, inner)
+            raise PlanError(f"unsupported cast to {e.to_type}")
+        if isinstance(e, ast.Extract):
+            if e.part != "year":
+                raise PlanError(f"EXTRACT({e.part}) unsupported")
+            return HCallUnary(
+                UnaryFunc.EXTRACT_YEAR, self.plan_expr(e.expr, scope)
+            )
+        if isinstance(e, ast.FuncCall):
+            if e.name in _AGG_FUNCS or e.star:
+                raise PlanError(
+                    f"aggregate {e.name} in a non-aggregated context"
+                )
+            if e.name == "coalesce":
+                return HCallVariadic(
+                    VariadicFunc.COALESCE,
+                    tuple(self.plan_expr(a, scope) for a in e.args),
+                )
+            if e.name == "abs":
+                return HCallUnary(
+                    UnaryFunc.ABS, self.plan_expr(e.args[0], scope)
+                )
+            raise PlanError(f"unknown function {e.name}")
+        if isinstance(e, ast.Exists):
+            rel, _ = self.plan_query(e.query)
+            return HExists(rel)
+        if isinstance(e, ast.ScalarSubquery):
+            rel, _ = self.plan_query(e.query)
+            return HScalarSubquery(rel)
+        if isinstance(e, ast.InSubquery):
+            rel, _ = self.plan_query(e.query)
+            x = self.plan_expr(e.expr, scope)
+            return HInSubquery(x, rel, e.negated)
+        raise NotImplementedError(type(e).__name__)
+
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class _PostAggColumn(ast.Expr):
+    """Internal AST marker: a column of the post-reduce relation."""
+
+    index: int
+
+
+def schema_with(schema: Schema, scalars) -> Schema:
+    return Schema(tuple(schema.columns) + tuple(c for _, c in scalars))
+
+
+def _rebrand(rel: HirRelation, schema: Schema) -> HirRelation:
+    return HRename(rel, schema)
+
+
+def _default_name(e: ast.Expr) -> str:
+    if isinstance(e, ast.Ident):
+        return e.parts[-1]
+    if isinstance(e, ast.FuncCall):
+        return e.name
+    return "column"
+
+
+def _contains_agg(e: ast.Expr) -> bool:
+    if isinstance(e, ast.FuncCall):
+        if e.name in _AGG_FUNCS or e.star:
+            return True
+        return any(_contains_agg(a) for a in e.args)
+    if isinstance(e, ast.BinaryOp):
+        return _contains_agg(e.left) or _contains_agg(e.right)
+    if isinstance(e, ast.UnaryOp):
+        return _contains_agg(e.expr)
+    if isinstance(e, ast.Cast):
+        return _contains_agg(e.expr)
+    if isinstance(e, ast.Case):
+        parts = [c for c, _ in e.whens] + [r for _, r in e.whens]
+        if e.operand:
+            parts.append(e.operand)
+        if e.else_:
+            parts.append(e.else_)
+        return any(_contains_agg(p) for p in parts)
+    return False
+
+
+def _ident_parts(e: ast.Expr) -> tuple:
+    if isinstance(e, ast.Ident):
+        return e.parts
+    raise PlanError("ORDER BY supports columns and output positions only")

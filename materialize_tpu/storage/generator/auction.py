@@ -1,0 +1,176 @@
+"""Auction load generator: deterministic, vectorized, host-side.
+
+Analog of the reference's AUCTION load-generator source
+(src/storage/src/source/generator/auction.rs): the five-table auction
+schema (organizations, users, accounts, auctions, bids). The reference's
+generator is insert-only (monotonic); this one adds an optional churn mode
+— retracting the bids of auctions that closed a few ticks earlier — so the
+AUCTION benchmark (BASELINE.json config 4: "streaming inserts/deletes,
+windowed TOP-K + DISTINCT") exercises the retraction path of TopK/Distinct
+the way the reference's feature benchmarks do.
+
+Static side tables (organizations/users/accounts) are emitted as a
+snapshot; auctions and bids stream per tick.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ...repr.batch import Batch
+from ...repr.schema import GLOBAL_DICT, Column, ColumnType, Schema
+
+ORGANIZATIONS_SCHEMA = Schema(
+    [
+        Column("id", ColumnType.INT64),
+        Column("name", ColumnType.STRING),
+    ]
+)
+
+USERS_SCHEMA = Schema(
+    [
+        Column("id", ColumnType.INT64),
+        Column("org_id", ColumnType.INT64),
+        Column("name", ColumnType.STRING),
+    ]
+)
+
+ACCOUNTS_SCHEMA = Schema(
+    [
+        Column("id", ColumnType.INT64),
+        Column("org_id", ColumnType.INT64),
+        Column("balance", ColumnType.INT64),
+    ]
+)
+
+AUCTIONS_SCHEMA = Schema(
+    [
+        Column("id", ColumnType.INT64),
+        Column("seller", ColumnType.INT64),
+        Column("item", ColumnType.STRING),
+        Column("end_time", ColumnType.TIMESTAMP),
+    ]
+)
+
+BIDS_SCHEMA = Schema(
+    [
+        Column("id", ColumnType.INT64),
+        Column("buyer", ColumnType.INT64),
+        Column("auction_id", ColumnType.INT64),
+        Column("amount", ColumnType.INT64),
+        Column("bid_time", ColumnType.TIMESTAMP),
+    ]
+)
+
+_ITEMS = (
+    "Signed Memorabilia",
+    "City Bar Crawl",
+    "Best Pizza in Town",
+    "Gift Basket",
+    "Custom Art",
+)
+
+_COMPANIES = ("Cavern", "Squab", "Pelican", "Buoy", "Quid")
+
+
+def _mk_batch(schema: Schema, cols, time: int, diffs=None) -> Batch:
+    n = len(cols[0]) if cols else 0
+    if diffs is None:
+        diffs = np.ones(n, np.int64)
+    return Batch.from_numpy(
+        schema, cols, np.full(n, time, np.uint64), np.asarray(diffs)
+    )
+
+
+@dataclass
+class AuctionGenerator:
+    """Deterministic auction stream.
+
+    Per tick: `auctions_per_tick` new auctions, each receiving
+    `bids_per_auction` bids (one winning-range amount distribution),
+    plus — in churn mode — retraction of every bid belonging to auctions
+    opened `retract_after` ticks earlier."""
+
+    seed: int = 0
+    n_users: int = 128
+    auctions_per_tick: int = 8
+    bids_per_auction: int = 8
+    retract_after: int | None = 4  # None = insert-only (reference behavior)
+
+    def __post_init__(self):
+        self._rng = np.random.default_rng(self.seed)
+        self._next_auction = 0
+        self._next_bid = 0
+        # tick -> (bid cols) retained for later retraction
+        self._live_bids: dict[int, list] = {}
+
+    # -- static side tables -------------------------------------------------
+    def snapshot(self, time: int = 0) -> dict:
+        org_ids = np.arange(len(_COMPANIES), dtype=np.int64)
+        orgs = _mk_batch(
+            ORGANIZATIONS_SCHEMA,
+            [org_ids, GLOBAL_DICT.encode_many(_COMPANIES)],
+            time,
+        )
+        uid = np.arange(self.n_users, dtype=np.int64)
+        users = _mk_batch(
+            USERS_SCHEMA,
+            [
+                uid,
+                uid % len(_COMPANIES),
+                GLOBAL_DICT.encode_many([f"user {i}" for i in uid]),
+            ],
+            time,
+        )
+        accounts = _mk_batch(
+            ACCOUNTS_SCHEMA,
+            [uid, uid % len(_COMPANIES), (uid * 97) % 10_000],
+            time,
+        )
+        return {"organizations": orgs, "users": users, "accounts": accounts}
+
+    # -- streaming tables ---------------------------------------------------
+    def tick(self, tick: int, time: int) -> dict:
+        """One tick of auction/bid traffic: {auctions: Batch, bids: Batch}."""
+        rng = self._rng
+        na = self.auctions_per_tick
+        a_ids = self._next_auction + np.arange(na, dtype=np.int64)
+        self._next_auction += na
+        sellers = rng.integers(0, self.n_users, na).astype(np.int64)
+        items = GLOBAL_DICT.encode_many(
+            [_ITEMS[i] for i in rng.integers(0, len(_ITEMS), na)]
+        )
+        end_times = (np.int64(time) + 10 + rng.integers(0, 10, na)).astype(
+            np.int64
+        )
+        auctions = _mk_batch(
+            AUCTIONS_SCHEMA, [a_ids, sellers, items, end_times], time
+        )
+
+        nb = na * self.bids_per_auction
+        b_ids = self._next_bid + np.arange(nb, dtype=np.int64)
+        self._next_bid += nb
+        buyers = rng.integers(0, self.n_users, nb).astype(np.int64)
+        b_auction = np.repeat(a_ids, self.bids_per_auction)
+        amounts = rng.integers(1, 100, nb).astype(np.int64)
+        bid_times = np.full(nb, time, dtype=np.int64)
+        bid_cols = [b_ids, buyers, b_auction, amounts, bid_times]
+
+        diffs = [np.ones(nb, np.int64)]
+        cols = [list(bid_cols)]
+        if self.retract_after is not None:
+            self._live_bids[tick] = bid_cols
+            old = tick - self.retract_after
+            old_cols = self._live_bids.pop(old, None)
+            if old_cols is not None:
+                cols.append(old_cols)
+                diffs.append(-np.ones(len(old_cols[0]), np.int64))
+        bids = _mk_batch(
+            BIDS_SCHEMA,
+            [np.concatenate([c[i] for c in cols]) for i in range(5)],
+            time,
+            np.concatenate(diffs),
+        )
+        return {"auctions": auctions, "bids": bids}

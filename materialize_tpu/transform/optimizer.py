@@ -1,0 +1,318 @@
+"""MIR→MIR optimizer: a fixpoint pipeline of rewrite transforms.
+
+Analog of the reference's ``transform`` crate ``Optimizer``
+(transform/src/lib.rs:742; logical_optimizer :752, physical_optimizer
+:822): each transform is a small pure rewrite run to fixpoint with an
+iteration bound. The v1 set covers the transforms that matter most for
+the TPU execution model (fewer operators = fewer kernels; narrower rows =
+fewer sort lanes):
+
+- FuseFilters / FuseProjects / FuseMaps  (transform/src/fusion)
+- PredicatePushdown                      (transform/src/predicate_pushdown.rs)
+- FoldConstants: trivial predicate elimination
+- ThresholdElision: Threshold over provably-nonnegative input
+- JoinImplementation: linear vs delta    (transform/src/join_implementation.rs)
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from ..expr import relation as mir
+from ..expr import scalar as ms
+
+
+def _children_replaced(expr: mir.RelationExpr, f):
+    """Rebuild expr with f applied to every relational child."""
+    if isinstance(expr, mir.Project):
+        return mir.Project(f(expr.input), expr.outputs)
+    if isinstance(expr, mir.Map):
+        return mir.Map(f(expr.input), expr.scalars)
+    if isinstance(expr, mir.Filter):
+        return mir.Filter(f(expr.input), expr.predicates)
+    if isinstance(expr, mir.FlatMap):
+        return mir.FlatMap(
+            f(expr.input), expr.func, expr.exprs, expr.output_cols
+        )
+    if isinstance(expr, mir.Join):
+        return mir.Join(
+            tuple(f(i) for i in expr.inputs),
+            expr.equivalences,
+            expr.implementation,
+        )
+    if isinstance(expr, mir.Reduce):
+        return mir.Reduce(f(expr.input), expr.group_key, expr.aggregates)
+    if isinstance(expr, mir.TopK):
+        return mir.TopK(
+            f(expr.input), expr.group_key, expr.order_by, expr.limit,
+            expr.offset,
+        )
+    if isinstance(expr, mir.Negate):
+        return mir.Negate(f(expr.input))
+    if isinstance(expr, mir.Threshold):
+        return mir.Threshold(f(expr.input))
+    if isinstance(expr, mir.Union):
+        return mir.Union(tuple(f(i) for i in expr.inputs))
+    if isinstance(expr, mir.ArrangeBy):
+        return mir.ArrangeBy(f(expr.input), expr.key)
+    if isinstance(expr, mir.Let):
+        return mir.Let(expr.name, f(expr.value), f(expr.body))
+    if isinstance(expr, mir.LetRec):
+        return mir.LetRec(
+            expr.names,
+            tuple(f(v) for v in expr.values),
+            expr.value_schemas,
+            f(expr.body),
+            expr.max_iters,
+        )
+    return expr  # Get, Constant
+
+
+def _bottom_up(expr, rewrite):
+    expr = _children_replaced(expr, lambda c: _bottom_up(c, rewrite))
+    return rewrite(expr)
+
+
+# -- transforms --------------------------------------------------------------
+
+
+def fuse(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """Filter∘Filter, Project∘Project, Map∘Map fusion
+    (transform/src/fusion/{filter,project,map}.rs)."""
+
+    def rw(e):
+        if isinstance(e, mir.Filter) and isinstance(e.input, mir.Filter):
+            return mir.Filter(
+                e.input.input, e.input.predicates + e.predicates
+            )
+        if isinstance(e, mir.Project) and isinstance(e.input, mir.Project):
+            inner = e.input
+            return mir.Project(
+                inner.input, tuple(inner.outputs[i] for i in e.outputs)
+            )
+        if isinstance(e, mir.Map) and isinstance(e.input, mir.Map):
+            inner = e.input
+            return mir.Map(inner.input, inner.scalars + e.scalars)
+        if isinstance(e, mir.Project) and e.outputs == tuple(
+            range(e.input.schema().arity)
+        ):
+            return e.input  # identity project
+        return e
+
+    return _bottom_up(expr, rw)
+
+
+def _shift_scalar(e: ms.ScalarExpr, mapping: dict) -> ms.ScalarExpr | None:
+    """Remap column references; None if any ref is unmapped."""
+    if isinstance(e, ms.ColumnRef):
+        if e.index not in mapping:
+            return None
+        return ms.ColumnRef(mapping[e.index])
+    if isinstance(e, ms.Literal):
+        return e
+    if isinstance(e, ms.CallUnary):
+        inner = _shift_scalar(e.expr, mapping)
+        return None if inner is None else ms.CallUnary(e.func, inner)
+    if isinstance(e, ms.CallBinary):
+        l = _shift_scalar(e.left, mapping)
+        r = _shift_scalar(e.right, mapping)
+        if l is None or r is None:
+            return None
+        return ms.CallBinary(e.func, l, r)
+    if isinstance(e, ms.CallVariadic):
+        parts = [_shift_scalar(x, mapping) for x in e.exprs]
+        if any(p is None for p in parts):
+            return None
+        return ms.CallVariadic(e.func, parts)
+    if isinstance(e, ms.If):
+        c = _shift_scalar(e.cond, mapping)
+        t = _shift_scalar(e.then, mapping)
+        f = _shift_scalar(e.els, mapping)
+        if c is None or t is None or f is None:
+            return None
+        return ms.If(c, t, f)
+    return None
+
+
+def _refs(e: ms.ScalarExpr, out: set) -> None:
+    if isinstance(e, ms.ColumnRef):
+        out.add(e.index)
+    elif isinstance(e, ms.CallUnary):
+        _refs(e.expr, out)
+    elif isinstance(e, ms.CallBinary):
+        _refs(e.left, out)
+        _refs(e.right, out)
+    elif isinstance(e, ms.CallVariadic):
+        for x in e.exprs:
+            _refs(x, out)
+    elif isinstance(e, ms.If):
+        _refs(e.cond, out)
+        _refs(e.then, out)
+        _refs(e.els, out)
+
+
+def predicate_pushdown(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """Push Filters toward sources (transform/src/predicate_pushdown.rs):
+    through Project/Map (when refs stay within the inner columns), into
+    Union branches, and into the owning input of a Join."""
+
+    def rw(e):
+        if not isinstance(e, mir.Filter):
+            return e
+        inp = e.input
+        if isinstance(inp, mir.Project):
+            mapping = {
+                pos: src for pos, src in enumerate(inp.outputs)
+            }
+            shifted = [
+                _shift_scalar(p, mapping) for p in e.predicates
+            ]
+            if all(s is not None for s in shifted):
+                return mir.Project(
+                    mir.Filter(inp.input, tuple(shifted)), inp.outputs
+                )
+        if isinstance(inp, mir.Map):
+            base = inp.input.schema().arity
+            inner_preds, kept = [], []
+            ident = {i: i for i in range(base)}
+            for p in e.predicates:
+                s = _shift_scalar(p, ident)
+                (inner_preds if s is not None else kept).append(
+                    s if s is not None else p
+                )
+            if inner_preds:
+                new = mir.Map(
+                    mir.Filter(inp.input, tuple(inner_preds)), inp.scalars
+                )
+                return mir.Filter(new, tuple(kept)) if kept else new
+        if isinstance(inp, mir.Union):
+            return mir.Union(
+                tuple(mir.Filter(i, e.predicates) for i in inp.inputs)
+            )
+        if isinstance(inp, mir.Negate):
+            return mir.Negate(mir.Filter(inp.input, e.predicates))
+        if isinstance(inp, mir.Join):
+            offsets = [0]
+            for i in inp.inputs:
+                offsets.append(offsets[-1] + i.schema().arity)
+            per_input: list = [[] for _ in inp.inputs]
+            kept = []
+            for p in e.predicates:
+                refs: set = set()
+                _refs(p, refs)
+                homes = [
+                    k
+                    for k in range(len(inp.inputs))
+                    if refs and all(
+                        offsets[k] <= r < offsets[k + 1] for r in refs
+                    )
+                ]
+                if homes:
+                    k = homes[0]
+                    shifted = _shift_scalar(
+                        p, {r: r - offsets[k] for r in refs}
+                    )
+                    per_input[k].append(shifted)
+                else:
+                    kept.append(p)
+            if any(per_input):
+                new_inputs = tuple(
+                    mir.Filter(i, tuple(ps)) if ps else i
+                    for i, ps in zip(inp.inputs, per_input)
+                )
+                new = mir.Join(
+                    new_inputs, inp.equivalences, inp.implementation
+                )
+                return mir.Filter(new, tuple(kept)) if kept else new
+        return e
+
+    return _bottom_up(expr, rw)
+
+
+def fold_constants(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """Drop literal-TRUE predicates; empty out literal-FALSE filters
+    (FoldConstants, transform/src/fold_constants.rs — value-level subset)."""
+
+    def rw(e):
+        if isinstance(e, mir.Filter):
+            preds = []
+            for p in e.predicates:
+                if isinstance(p, ms.Literal):
+                    if p.value is True:
+                        continue
+                    return mir.Constant((), e.schema())
+                preds.append(p)
+            if not preds:
+                return e.input
+            return mir.Filter(e.input, tuple(preds))
+        return e
+
+    return _bottom_up(expr, rw)
+
+
+def threshold_elision(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """Remove Threshold over inputs that cannot go negative
+    (transform/src/threshold_elision.rs): anything without Negate below."""
+
+    def nonneg(e) -> bool:
+        if isinstance(e, (mir.Negate,)):
+            return False
+        if isinstance(e, mir.Constant):
+            return all(d >= 0 for _, d in e.rows)
+        if isinstance(e, (mir.Get,)):
+            return True  # sources/lets: assumed nonnegative collections
+        return all(nonneg(c) for c in e.children())
+
+    def rw(e):
+        if isinstance(e, mir.Threshold) and nonneg(e.input):
+            return e.input
+        return e
+
+    return _bottom_up(expr, rw)
+
+
+def join_implementation(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """Resolve implementation="auto" (JoinImplementation analog): delta
+    for 3+ inputs (no intermediate arrangements — delta_join.rs:10-12),
+    linear for binary joins."""
+
+    def rw(e):
+        if isinstance(e, mir.Join) and e.implementation == "auto":
+            impl = "delta" if len(e.inputs) >= 3 else "linear"
+            return mir.Join(e.inputs, e.equivalences, impl)
+        return e
+
+    return _bottom_up(expr, rw)
+
+
+LOGICAL_TRANSFORMS = (
+    fuse,
+    fold_constants,
+    predicate_pushdown,
+    threshold_elision,
+)
+PHYSICAL_TRANSFORMS = (join_implementation,)
+
+
+def logical_optimizer(
+    expr: mir.RelationExpr, max_iters: int = 10
+) -> mir.RelationExpr:
+    """Run the logical transform set to fixpoint (transform/src/lib.rs:752
+    analog; bounded like the reference's fuel limits)."""
+    for _ in range(max_iters):
+        before = expr
+        for t in LOGICAL_TRANSFORMS:
+            expr = t(expr)
+        if expr == before:
+            break
+    return expr
+
+
+def physical_optimizer(expr: mir.RelationExpr) -> mir.RelationExpr:
+    for t in PHYSICAL_TRANSFORMS:
+        expr = t(expr)
+    return expr
+
+
+def optimize(expr: mir.RelationExpr) -> mir.RelationExpr:
+    return physical_optimizer(logical_optimizer(expr))

@@ -1,0 +1,34 @@
+"""AUCTION workloads: windowed TOP-K + DISTINCT over the bid stream
+(BASELINE.json config 4; reference load generator
+storage/src/source/generator/auction.rs and the auction demo views).
+
+Two maintained views:
+
+- ``auction_topk_mir``: the top-`k` bids per auction by amount
+  (``MonotonicTopK``-shaped plan in the reference; here the single
+  sorted-arrangement TopK, ops/topk.py).
+- ``auction_winning_bidders_mir``: DISTINCT buyers currently holding a
+  top-`k` position — TopK feeding Distinct, the plan shape the reference's
+  feature benchmarks exercise.
+"""
+
+from __future__ import annotations
+
+from ..expr import relation as mir
+from ..storage.generator.auction import BIDS_SCHEMA
+
+
+def auction_topk_mir(k: int = 3) -> mir.RelationExpr:
+    """Top-k bids per auction: group by auction_id, order amount DESC."""
+    bids = mir.Get("bids", BIDS_SCHEMA)
+    return mir.TopK(
+        bids,
+        group_key=(2,),  # auction_id
+        order_by=((3, True, False),),  # amount DESC
+        limit=k,
+    )
+
+
+def auction_winning_bidders_mir(k: int = 3) -> mir.RelationExpr:
+    """DISTINCT buyers holding a top-k bid on any auction."""
+    return auction_topk_mir(k).project((1,)).distinct()

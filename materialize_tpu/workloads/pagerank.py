@@ -1,0 +1,81 @@
+"""PageRank over the TPC-H orders→suppliers graph, as WITH MUTUALLY
+RECURSIVE (BASELINE.json config 5).
+
+The graph: bipartite orderkey -> suppkey edges from lineitem (each
+lineitem links the order placing it to the supplier fulfilling it),
+derived from the reference's TPCH load generator relations
+(storage/src/source/generator/tpch.rs).
+
+MIR shape (the SQL a user would write with WITH MUTUALLY RECURSIVE):
+
+    ranks(n, r) := SELECT n, SUM(c) FROM (
+        SELECT n, 0.15 AS c FROM nodes
+        UNION ALL
+        SELECT e.dst, 0.85 * r.rank / d.deg
+        FROM ranks r JOIN out_deg d ON r.n = d.n
+                     JOIN edges  e ON r.n = e.src
+    ) GROUP BY n
+
+i.e. rank(k+1) = base + damped incoming of rank(k) — the power-iteration
+fixpoint, iterated to ``max_iters`` (float fixpoints stop on the
+iteration cap: RETURN AT RECURSION LIMIT semantics, reference
+expr/src/relation.rs LetRec limits).
+"""
+
+from __future__ import annotations
+
+from ..expr import relation as mir
+from ..expr.relation import AggregateExpr, AggregateFunc
+from ..expr.scalar import BinaryFunc, CallBinary, ColumnRef, col, lit
+from ..repr.schema import Column, ColumnType, Schema
+
+
+def pagerank_mir(edge_schema: Schema, max_iters: int = 25) -> mir.RelationExpr:
+    """rank(n) = 0.15 + 0.85 * sum_{m->n} rank(m) / out_deg(m).
+
+    edges: (src int64, dst int64). Returns (n, rank float64)."""
+    edges = mir.Get("edges", edge_schema)
+
+    # out_deg: (src, deg)
+    out_deg = edges.reduce(
+        (0,), (AggregateExpr(AggregateFunc.COUNT, col(1)),)
+    )
+
+    # nodes: distinct src ∪ dst (sink-only nodes still get base rank)
+    nodes = mir.Union(
+        (edges.project((0,)), edges.project((1,)))
+    ).distinct()
+
+    # base contribution rows: (node, 0.15)
+    base = nodes.map((lit(0.15),))
+
+    rank_schema = Schema(
+        [edge_schema[0], Column("rank", ColumnType.FLOAT64, True)]
+    )
+    ranks = mir.Get("ranks", rank_schema)
+
+    # (n, r) ⋈ (n, deg) on node  ->  (n, r, n, deg)
+    r_with_deg = mir.Join(
+        (ranks, out_deg), ((ColumnRef(0), ColumnRef(2)),)
+    )
+    # ++ (src, dst) joined on n = src  ->  6 cols
+    r_deg_edges = mir.Join(
+        (r_with_deg, edges), ((ColumnRef(0), ColumnRef(4)),)
+    )
+    # damped per-edge contribution rows: (dst, 0.85 * r / deg)
+    per_edge = CallBinary(BinaryFunc.DIV, col(1) * lit(0.85), col(3))
+    contrib = r_deg_edges.map((per_edge,)).project((5, 6))
+
+    # rank(n) = SUM of contribution rows (Union is multiset concatenation;
+    # the Reduce does the arithmetic).
+    value = mir.Union((base, contrib)).reduce(
+        (0,), (AggregateExpr(AggregateFunc.SUM_FLOAT, col(1)),)
+    )
+
+    return mir.LetRec(
+        names=("ranks",),
+        values=(value,),
+        value_schemas=(rank_schema,),
+        body=mir.Get("ranks", rank_schema),
+        max_iters=max_iters,
+    )

@@ -11,9 +11,16 @@ from __future__ import annotations
 
 from ..expr import relation as mir
 from ..expr.relation import AggregateExpr, AggregateFunc
-from ..expr.scalar import col, lit
+from ..expr.scalar import CallUnary, UnaryFunc, col, lit
 from ..repr.schema import ColumnType
-from ..storage.generator.tpch import LINEITEM_SCHEMA, SUPPLIER_SCHEMA
+from ..storage.generator.tpch import (
+    LINEITEM_SCHEMA,
+    NATION_SCHEMA,
+    ORDERS_SCHEMA,
+    PART_SCHEMA,
+    PARTSUPP_SCHEMA,
+    SUPPLIER_SCHEMA,
+)
 
 # date '1998-12-01' - 90 days, as a day number since 1970-01-01
 Q1_CUTOFF = 8035 + 2526 - 90
@@ -94,3 +101,49 @@ def q15_mir() -> mir.RelationExpr:
         equivalences=((col(0), col(3)), (col(4), col(5))),
     ).project([0, 2, 4])  # s_suppkey, s_name, total_revenue
     return mir.Let("__revenue__", revenue, joined)
+
+
+def q9_mir() -> mir.RelationExpr:
+    """TPCH Q9 (product-type profit): 6-relation delta join + GROUP BY.
+
+    Exercises JoinPlan::Delta — one update pipeline per input over shared
+    arrangements (render/join/delta_join.rs:51; BASELINE.json config 3).
+    The reference's ``p_name LIKE '%green%'`` filter is omitted
+    (dictionary-coded strings have no device substring search yet); the
+    join/aggregate plan shape is identical.
+
+    Output: (n_name, o_year, sum_profit scale-4 decimal).
+    """
+    li, pt, sp = LINEITEM_SCHEMA, PART_SCHEMA, SUPPLIER_SCHEMA
+    ps, od, na = PARTSUPP_SCHEMA, ORDERS_SCHEMA, NATION_SCHEMA
+    i = li.index_of
+    # Global column offsets: lineitem 0..12, part 13..15, supplier 16..18,
+    # partsupp 19..21, orders 22..27, nation 28..30.
+    joined = mir.Join(
+        (
+            mir.Get("lineitem", li),
+            mir.Get("part", pt),
+            mir.Get("supplier", sp),
+            mir.Get("partsupp", ps),
+            mir.Get("orders", od),
+            mir.Get("nation", na),
+        ),
+        equivalences=(
+            (col(i("l_suppkey")), col(16), col(20)),  # = s_suppkey = ps_suppkey
+            (col(i("l_partkey")), col(13), col(19)),  # = p_partkey = ps_partkey
+            (col(i("l_orderkey")), col(22)),          # = o_orderkey
+            (col(17), col(28)),                       # s_nationkey = n_nationkey
+        ),
+    )
+    one = lit(100, ColumnType.DECIMAL, 2)  # 1.00
+    amount = col(i("l_extendedprice")) * (one - col(i("l_discount"))) - col(
+        21
+    ) * col(i("l_quantity"))  # scale 4
+    o_year = CallUnary(UnaryFunc.EXTRACT_YEAR, col(26))
+    return (
+        joined.map([amount, o_year])  # -> cols 31, 32
+        .project([30, 32, 31])  # n_name, o_year, amount
+        .reduce(
+            (0, 1), (AggregateExpr(AggregateFunc.SUM_INT, col(2)),)
+        )
+    )

@@ -1,0 +1,152 @@
+"""Delta join tests: randomized multi-way joins vs a host oracle, plus
+TPCH Q9 (6-relation delta join; BASELINE.json config 3)."""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.expr import relation as mir
+from materialize_tpu.expr.scalar import ColumnRef
+from materialize_tpu.render.dataflow import Dataflow
+from materialize_tpu.repr.batch import Batch
+from materialize_tpu.repr.schema import Column, ColumnType, Schema
+from materialize_tpu.storage.generator.tpch import (
+    LINEITEM_SCHEMA,
+    ORDERS_SCHEMA,
+    TpchGenerator,
+)
+from materialize_tpu.workloads.tpch import q9_mir
+
+
+def _mk_batch(schema, cols, diffs, time=0):
+    n = len(diffs)
+    return Batch.from_numpy(
+        schema, cols, np.full(n, time, np.uint64), np.asarray(diffs)
+    )
+
+
+def _peek_multiset(df):
+    out = {}
+    for r in df.peek():
+        out[r[:-2]] = out.get(r[:-2], 0) + r[-1]
+    return {k: d for k, d in out.items() if d != 0}
+
+
+AB = Schema([Column("a", ColumnType.INT64), Column("b", ColumnType.INT64)])
+BC = Schema([Column("b", ColumnType.INT64), Column("c", ColumnType.INT64)])
+CD = Schema([Column("c", ColumnType.INT64), Column("d", ColumnType.INT64)])
+
+
+def _three_way():
+    """R(a,b) ⋈ S(b,c) ⋈ T(c,d) — forced delta implementation."""
+    return mir.Join(
+        (mir.Get("R", AB), mir.Get("S", BC), mir.Get("T", CD)),
+        equivalences=(
+            (ColumnRef(1), ColumnRef(2)),
+            (ColumnRef(3), ColumnRef(4)),
+        ),
+        implementation="delta",
+    )
+
+
+def _oracle_join(rs, ss, ts):
+    out = {}
+    for (a, b), m1 in rs.items():
+        for (b2, c), m2 in ss.items():
+            if b != b2:
+                continue
+            for (c2, d), m3 in ts.items():
+                if c != c2:
+                    continue
+                k = (a, b, b2, c, c2, d)
+                out[k] = out.get(k, 0) + m1 * m2 * m3
+    return {k: m for k, m in out.items() if m != 0}
+
+
+class TestDeltaJoin:
+    def test_randomized_three_way_with_retractions(self):
+        df = Dataflow(_three_way())
+        rng = np.random.default_rng(17)
+        rs, ss, ts = {}, {}, {}
+        for step in range(4):
+            batches = {}
+            for name, ms in (("R", rs), ("S", ss), ("T", ts)):
+                n = 25
+                x = rng.integers(0, 6, n)
+                y = rng.integers(0, 6, n)
+                d = rng.integers(-1, 2, n)
+                d[d == 0] = 1
+                sch = {"R": AB, "S": BC, "T": CD}[name]
+                batches[name] = _mk_batch(sch, [x, y], d, time=step)
+                for xx, yy, dd in zip(x, y, d):
+                    k = (int(xx), int(yy))
+                    ms[k] = ms.get(k, 0) + int(dd)
+            df.step(batches)
+            assert _peek_multiset(df) == _oracle_join(rs, ss, ts)
+
+    def test_concurrent_deltas_counted_once(self):
+        # All three inputs change in the SAME step; before/after
+        # discipline must count each combination exactly once.
+        df = Dataflow(_three_way())
+        df.step(
+            {
+                "R": _mk_batch(AB, [np.array([1]), np.array([2])], [1]),
+                "S": _mk_batch(BC, [np.array([2]), np.array([3])], [1]),
+                "T": _mk_batch(CD, [np.array([3]), np.array([4])], [1]),
+            }
+        )
+        assert _peek_multiset(df) == {(1, 2, 2, 3, 3, 4): 1}
+
+
+class TestQ9:
+    def test_q9_maintained_vs_oracle(self):
+        gen = TpchGenerator(sf=0.01, seed=9)
+        df = Dataflow(q9_mir())
+        static = {
+            name: gen.table_batch(name)
+            for name in ("part", "supplier", "partsupp", "nation")
+        }
+        orders_keys = np.arange(1, 40, dtype=np.int64)
+        li_cols = gen.lineitems_for_orders(orders_keys)
+        od_cols = gen.orders_rows(orders_keys)
+        inputs = dict(static)
+        inputs["lineitem"] = _mk_batch(
+            LINEITEM_SCHEMA, li_cols, np.ones(len(li_cols[0]), np.int64)
+        )
+        inputs["orders"] = _mk_batch(
+            ORDERS_SCHEMA, od_cols, np.ones(len(od_cols[0]), np.int64)
+        )
+        df.step(inputs)
+
+        # Host oracle over the same rows.
+        import collections
+        li = list(zip(*[np.asarray(c) for c in li_cols]))
+        od = {int(r[0]): r for r in zip(*[np.asarray(c) for c in od_cols])}
+        pt = {r[0]: r for r in
+              zip(*[np.asarray(c) for c in gen.part_table()])}
+        sp = {r[0]: r for r in
+              zip(*[np.asarray(c) for c in gen.supplier_table()])}
+        ps = {(r[0], r[1]): r for r in
+              zip(*[np.asarray(c) for c in gen.partsupp_table()])}
+        na = {r[0]: r for r in
+              zip(*[np.asarray(c) for c in gen.nation_table()])}
+        want = collections.defaultdict(int)
+        for r in li:
+            okey, pkey, skey, qty = int(r[0]), int(r[1]), int(r[2]), int(r[4])
+            eprice, disc = int(r[5]), int(r[6])
+            if (pkey, skey) not in ps or pkey not in pt or skey not in sp:
+                continue
+            if okey not in od:
+                continue
+            supplycost = int(ps[(pkey, skey)][2])
+            amount = eprice * (100 - disc) - supplycost * qty
+            nation = int(na[int(sp[skey][1])][2])
+            odate = int(od[okey][4])
+            # o_year via civil calendar: reuse numpy datetime
+            year = (np.datetime64("1970-01-01") +
+                    np.timedelta64(odate, "D")).astype("datetime64[Y]")
+            year = int(str(year))
+            want[(nation, year, )] = want[(nation, year)] + amount
+        got = _peek_multiset(df)
+        got_sums = {(k[0], k[1]): k[2] for k in got}
+        want_sums = {k: v for k, v in want.items()}
+        assert got_sums == want_sums

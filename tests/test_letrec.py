@@ -1,0 +1,162 @@
+"""LetRec (WITH MUTUALLY RECURSIVE) tests: transitive closure maintained
+incrementally, and PageRank to a float fixpoint — checked against host
+oracles (SURVEY.md §2.3 LetRec; render.rs:887 analog)."""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.expr import relation as mir
+from materialize_tpu.expr.scalar import ColumnRef
+from materialize_tpu.render.dataflow import Dataflow
+from materialize_tpu.repr.batch import Batch
+from materialize_tpu.repr.schema import Column, ColumnType, Schema
+from materialize_tpu.workloads.pagerank import pagerank_mir
+
+EDGE = Schema([Column("src", ColumnType.INT64), Column("dst", ColumnType.INT64)])
+
+
+def _mk_batch(schema, cols, diffs, time=0):
+    n = len(diffs)
+    return Batch.from_numpy(
+        schema, cols, np.full(n, time, np.uint64), np.asarray(diffs)
+    )
+
+
+def _peek_set(df):
+    out = {}
+    for r in df.peek():
+        out[r[:-2]] = out.get(r[:-2], 0) + r[-1]
+    return {k for k, d in out.items() if d != 0}
+
+
+def _closure(edges: set) -> set:
+    reach = set(edges)
+    while True:
+        new = {(a, d) for (a, b) in reach for (c, d) in edges if b == c}
+        if new <= reach:
+            return reach
+        reach |= new
+
+
+def _tc_mir():
+    """reach = DISTINCT(edges ∪ project(reach ⋈ edges on dst=src))."""
+    edges = mir.Get("edges", EDGE)
+    reach = mir.Get("reach", EDGE)
+    step = mir.Join(
+        (reach, edges), ((ColumnRef(1), ColumnRef(2)),)
+    ).project((0, 3))
+    value = mir.Union((edges, step)).distinct()
+    return mir.LetRec(
+        names=("reach",),
+        values=(value,),
+        value_schemas=(EDGE,),
+        body=mir.Get("reach", EDGE),
+    )
+
+
+class TestTransitiveClosure:
+    def test_chain_and_incremental_growth(self):
+        df = Dataflow(_tc_mir())
+        # chain 0->1->2->3
+        e = {(0, 1), (1, 2), (2, 3)}
+        df.step(
+            {"edges": _mk_batch(EDGE, [np.array([0, 1, 2]),
+                                       np.array([1, 2, 3])], [1, 1, 1])}
+        )
+        assert _peek_set(df) == _closure(e)
+        # add 3->4: closure extends through the whole chain
+        e.add((3, 4))
+        df.step(
+            {"edges": _mk_batch(EDGE, [np.array([3]), np.array([4])],
+                                [1], time=1)}
+        )
+        assert _peek_set(df) == _closure(e)
+
+    def test_branching_random_dag(self):
+        rng = np.random.default_rng(7)
+        df = Dataflow(_tc_mir())
+        e = set()
+        for step in range(3):
+            src = rng.integers(0, 12, 15)
+            off = rng.integers(1, 4, 15)
+            dst = np.minimum(src + off, 14)  # edges only go "up": a DAG
+            pairs = {(int(a), int(b)) for a, b in zip(src, dst) if a != b}
+            pairs -= e
+            if not pairs:
+                continue
+            e |= pairs
+            arr = np.array(sorted(pairs))
+            df.step(
+                {"edges": _mk_batch(EDGE, [arr[:, 0], arr[:, 1]],
+                                    np.ones(len(arr), np.int64), time=step)}
+            )
+            assert _peek_set(df) == _closure(e)
+
+    def test_acyclic_retraction(self):
+        df = Dataflow(_tc_mir())
+        # 0->1->2 plus direct 0->2: retracting 0->1 keeps 0->2 reachable
+        df.step(
+            {"edges": _mk_batch(EDGE, [np.array([0, 1, 0]),
+                                       np.array([1, 2, 2])], [1, 1, 1])}
+        )
+        assert _peek_set(df) == {(0, 1), (1, 2), (0, 2)}
+        df.step(
+            {"edges": _mk_batch(EDGE, [np.array([0]), np.array([1])],
+                                [-1], time=1)}
+        )
+        assert _peek_set(df) == {(1, 2), (0, 2)}
+
+
+def _pagerank_oracle(edges, n_iters=60):
+    nodes = sorted({a for a, _ in edges} | {b for _, b in edges})
+    deg = {}
+    for a, _ in edges:
+        deg[a] = deg.get(a, 0) + 1
+    r = {n: 0.15 for n in nodes}
+    for _ in range(n_iters):
+        nxt = {n: 0.15 for n in nodes}
+        for a, b in edges:
+            nxt[b] += 0.85 * r[a] / deg[a]
+        r = nxt
+    return r
+
+
+class TestPageRank:
+    def test_fixpoint_matches_oracle(self):
+        edges = [(0, 1), (1, 2), (2, 0), (0, 2), (3, 2)]
+        # Both sides run far past float convergence, so iteration-count
+        # off-by-ones between oracle and device cannot show through.
+        df = Dataflow(pagerank_mir(EDGE, max_iters=300))
+        arr = np.array(edges)
+        df.step(
+            {"edges": _mk_batch(EDGE, [arr[:, 0], arr[:, 1]],
+                                np.ones(len(arr), np.int64))}
+        )
+        got = {}
+        for r in df.peek():
+            got[r[0]] = got.get(r[0], 0.0) + r[1] * r[-1]
+        want = _pagerank_oracle(edges, n_iters=600)
+        assert set(got) == set(want)
+        for n in want:
+            assert got[n] == pytest.approx(want[n], rel=1e-9)
+
+    def test_incremental_edge_addition(self):
+        edges = [(0, 1), (1, 0)]
+        df = Dataflow(pagerank_mir(EDGE, max_iters=80))
+        arr = np.array(edges)
+        df.step(
+            {"edges": _mk_batch(EDGE, [arr[:, 0], arr[:, 1]],
+                                np.ones(len(arr), np.int64))}
+        )
+        edges2 = edges + [(1, 2), (2, 0)]
+        arr2 = np.array([(1, 2), (2, 0)])
+        df.step(
+            {"edges": _mk_batch(EDGE, [arr2[:, 0], arr2[:, 1]],
+                                [1, 1], time=1)}
+        )
+        got = {}
+        for r in df.peek():
+            got[r[0]] = got.get(r[0], 0.0) + r[1] * r[-1]
+        want = _pagerank_oracle(edges2, n_iters=200)
+        for n in want:
+            assert got[n] == pytest.approx(want[n], rel=1e-3)

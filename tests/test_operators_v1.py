@@ -1,0 +1,188 @@
+"""Operator set v1 tests: Threshold, TopK, FlatMap, Distinct — randomized
+incremental runs checked against a host-side oracle (the datadriven-test
+analog, SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.expr import relation as mir
+from materialize_tpu.expr.scalar import col, lit
+from materialize_tpu.render.dataflow import Dataflow
+from materialize_tpu.repr.batch import Batch
+from materialize_tpu.repr.schema import Column, ColumnType, Schema
+
+from .oracle import as_multiset
+
+
+def _mk_batch(schema, cols, diffs, time=0):
+    n = len(diffs)
+    return Batch.from_numpy(
+        schema, cols, np.full(n, time, np.uint64), np.asarray(diffs)
+    )
+
+
+KV = Schema([Column("k", ColumnType.INT64), Column("v", ColumnType.INT64)])
+
+
+def _peek_multiset(df):
+    out = {}
+    for r in df.peek():
+        key = r[:-2]
+        out[key] = out.get(key, 0) + r[-1]
+    return {k: d for k, d in out.items() if d != 0}
+
+
+class TestThreshold:
+    def test_negative_multiplicities_suppressed(self):
+        expr = mir.Get("in", KV).threshold()
+        df = Dataflow(expr)
+        # (1,1)x2, (2,2)x-1: threshold keeps (1,1)x2 only
+        b = _mk_batch(KV, [np.array([1, 1, 2]), np.array([1, 1, 2])],
+                      [1, 1, -1])
+        df.step({"in": b})
+        assert _peek_multiset(df) == {(1, 1): 2}
+        # now (2,2) goes positive: +3 -> net 2 -> visible at 2
+        b2 = _mk_batch(KV, [np.array([2]), np.array([2])], [3], time=1)
+        df.step({"in": b2})
+        assert _peek_multiset(df) == {(1, 1): 2, (2, 2): 2}
+
+    def test_randomized_matches_oracle(self):
+        expr = mir.Get("in", KV).threshold()
+        df = Dataflow(expr)
+        rng = np.random.default_rng(11)
+        acc = {}
+        for step in range(4):
+            n = 100
+            k = rng.integers(0, 5, n)
+            v = rng.integers(0, 4, n)
+            d = rng.integers(-2, 3, n)
+            d[d == 0] = 1
+            df.step({"in": _mk_batch(KV, [k, v], d, time=step)})
+            for kk, vv, dd in zip(k, v, d):
+                key = (int(kk), int(vv))
+                acc[key] = acc.get(key, 0) + int(dd)
+        want = {k: m for k, m in acc.items() if m > 0}
+        assert _peek_multiset(df) == want
+
+
+def _topk_oracle(ms, group_idx, order_idx, desc, limit, offset):
+    """Expected TopK output multiset from an input multiset."""
+    groups = {}
+    for row, m in ms.items():
+        if m <= 0:
+            continue
+        groups.setdefault(row[group_idx], []).extend([row] * m)
+    out = {}
+    for rows in groups.values():
+        # Device tie-break: order lanes first, remaining columns ascending.
+        key = (
+            (lambda r: (-r[order_idx],) + r)
+            if desc
+            else (lambda r: (r[order_idx],) + r)
+        )
+        rows.sort(key=key)
+        end = None if limit is None else offset + limit
+        for r in rows[offset:end]:
+            out[r] = out.get(r, 0) + 1
+    return out
+
+
+class TestTopK:
+    @pytest.mark.parametrize("desc", [False, True])
+    @pytest.mark.parametrize("limit,offset", [(2, 0), (1, 0), (3, 1)])
+    def test_randomized_matches_oracle(self, desc, limit, offset):
+        expr = mir.TopK(
+            mir.Get("in", KV), (0,), ((1, desc, False),), limit, offset
+        )
+        df = Dataflow(expr)
+        rng = np.random.default_rng(23)
+        ms = {}
+        inserted = []
+        for step in range(3):
+            n = 60
+            k = rng.integers(0, 4, n)
+            v = rng.integers(0, 50, n)
+            d = np.ones(n, np.int64)
+            if step > 0:
+                # retract some previously inserted rows
+                take = rng.integers(0, len(inserted), 10)
+                k = np.concatenate([k, [inserted[i][0] for i in take]])
+                v = np.concatenate([v, [inserted[i][1] for i in take]])
+                d = np.concatenate([d, -np.ones(10, np.int64)])
+            df.step({"in": _mk_batch(KV, [k, v], d, time=step)})
+            for a, b, dd in zip(k, v, d):
+                key = (int(a), int(b))
+                ms[key] = ms.get(key, 0) + int(dd)
+                if dd > 0:
+                    inserted.append(key)
+        want = _topk_oracle(ms, 0, 1, desc, limit, offset)
+        assert _peek_multiset(df) == want
+
+    def test_retraction_pulls_in_next_row(self):
+        # group 7 has values 10, 20, 30; top-2 asc = {10, 20};
+        # retracting 10 pulls 30 into the window.
+        expr = mir.TopK(mir.Get("in", KV), (0,), ((1, False, False),), 2, 0)
+        df = Dataflow(expr)
+        b = _mk_batch(KV, [np.full(3, 7), np.array([10, 20, 30])], [1, 1, 1])
+        df.step({"in": b})
+        assert _peek_multiset(df) == {(7, 10): 1, (7, 20): 1}
+        b2 = _mk_batch(KV, [np.array([7]), np.array([10])], [-1], time=1)
+        d = df.step({"in": b2})
+        assert _peek_multiset(df) == {(7, 20): 1, (7, 30): 1}
+        # and the delta is exactly the window change
+        delta = {}
+        for r in d.to_rows():
+            delta[r[:-2]] = delta.get(r[:-2], 0) + r[-1]
+        assert {k: v for k, v in delta.items() if v} == {
+            (7, 10): -1, (7, 30): 1
+        }
+
+
+class TestFlatMap:
+    def test_generate_series(self):
+        s = Schema([Column("a", ColumnType.INT64)])
+        expr = mir.FlatMap(
+            mir.Get("in", s),
+            "generate_series",
+            (lit(1), col(0)),
+            (Column("series", ColumnType.INT64),),
+        )
+        df = Dataflow(expr)
+        b = _mk_batch(s, [np.array([3, 0, 2])], [1, 1, 2])
+        df.step({"in": b})
+        want = {(3, 1): 1, (3, 2): 1, (3, 3): 1, (2, 1): 2, (2, 2): 2}
+        assert _peek_multiset(df) == want
+
+    def test_overflow_grows_and_retries(self):
+        s = Schema([Column("a", ColumnType.INT64)])
+        expr = mir.FlatMap(
+            mir.Get("in", s),
+            "generate_series",
+            (lit(1), col(0)),
+            (Column("series", ColumnType.INT64),),
+        )
+        df = Dataflow(expr)
+        df._ctx.join_caps[0] = 4  # tiny fan-out tier to force overflow
+        df._remake_jit()
+        b = _mk_batch(s, [np.array([9])], [1])
+        df.step({"in": b})
+        assert len(_peek_multiset(df)) == 9
+
+
+class TestDistinct:
+    def test_distinct_matches_oracle(self):
+        expr = mir.Get("in", KV).distinct()
+        df = Dataflow(expr)
+        rng = np.random.default_rng(3)
+        acc = {}
+        for step in range(3):
+            k = rng.integers(0, 4, 80)
+            v = rng.integers(0, 3, 80)
+            d = rng.integers(-1, 2, 80)
+            d[d == 0] = 1
+            df.step({"in": _mk_batch(KV, [k, v], d, time=step)})
+            for kk, vv, dd in zip(k, v, d):
+                key = (int(kk), int(vv))
+                acc[key] = acc.get(key, 0) + int(dd)
+        want = {k: 1 for k, m in acc.items() if m > 0}
+        assert _peek_multiset(df) == want

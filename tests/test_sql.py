@@ -1,0 +1,263 @@
+"""SQL frontend tests: lexer/parser round trips, planning to MIR, and
+end-to-end SQL → dataflow → results vs oracle (the sqllogictest analog,
+SURVEY.md §4.2)."""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.render.dataflow import Dataflow
+from materialize_tpu.repr.batch import Batch
+from materialize_tpu.repr.schema import Column, ColumnType, Schema
+from materialize_tpu.sql import ast
+from materialize_tpu.sql.catalog import Catalog, CatalogItem
+from materialize_tpu.sql.parser import parse_statement
+from materialize_tpu.sql.plan import (
+    CreateViewPlan,
+    ExplainPlan,
+    SelectPlan,
+    plan_statement,
+)
+from materialize_tpu.transform.optimizer import optimize
+
+
+def _mk_batch(schema, cols, diffs, time=0):
+    n = len(diffs)
+    return Batch.from_numpy(
+        schema, cols, np.full(n, time, np.uint64), np.asarray(diffs)
+    )
+
+
+def _catalog():
+    cat = Catalog()
+    cat.create(
+        CatalogItem(
+            "t",
+            "source",
+            Schema(
+                [
+                    Column("k", ColumnType.INT64),
+                    Column("v", ColumnType.INT64),
+                ]
+            ),
+        )
+    )
+    cat.create(
+        CatalogItem(
+            "s",
+            "source",
+            Schema(
+                [
+                    Column("k", ColumnType.INT64),
+                    Column("w", ColumnType.INT64),
+                ]
+            ),
+        )
+    )
+    cat.create(
+        CatalogItem(
+            "edges",
+            "source",
+            Schema(
+                [
+                    Column("src", ColumnType.INT64),
+                    Column("dst", ColumnType.INT64),
+                ]
+            ),
+        )
+    )
+    return cat
+
+
+def _run(sql, inputs, cat=None):
+    plan = plan_statement(sql, cat or _catalog())
+    assert isinstance(plan, (SelectPlan, CreateViewPlan))
+    df = Dataflow(optimize(plan.expr))
+    df.step(inputs)
+    out = {}
+    for r in df.peek():
+        out[r[:-2]] = out.get(r[:-2], 0) + r[-1]
+    return {k: d for k, d in out.items() if d != 0}
+
+
+T = Schema([Column("k", ColumnType.INT64), Column("v", ColumnType.INT64)])
+S = Schema([Column("k", ColumnType.INT64), Column("w", ColumnType.INT64)])
+E = Schema([Column("src", ColumnType.INT64), Column("dst", ColumnType.INT64)])
+
+
+class TestParser:
+    def test_select_roundtrip(self):
+        stmt = parse_statement(
+            "SELECT k, sum(v) AS total FROM t WHERE v > 3 "
+            "GROUP BY k HAVING count(*) > 1 ORDER BY total DESC LIMIT 5"
+        )
+        assert isinstance(stmt, ast.SelectStatement)
+        q = stmt.query
+        assert q.limit == 5
+        sel = q.body.select
+        assert sel.items[1].alias == "total"
+        assert sel.having is not None
+
+    def test_create_materialized_view(self):
+        stmt = parse_statement(
+            "CREATE MATERIALIZED VIEW mv AS SELECT k FROM t"
+        )
+        assert isinstance(stmt, ast.CreateView)
+        assert stmt.materialized
+
+    def test_create_source_load_generator(self):
+        stmt = parse_statement(
+            "CREATE SOURCE lg FROM LOAD GENERATOR tpch (SCALE FACTOR 0.1)"
+        )
+        assert isinstance(stmt, ast.CreateSource)
+        assert stmt.generator == "tpch"
+        assert stmt.options.get("scale factor") == 0.1
+
+    def test_wmr_parse(self):
+        stmt = parse_statement(
+            "WITH MUTUALLY RECURSIVE reach (a int, b int) AS "
+            "(SELECT * FROM edges UNION "
+            "SELECT r.a, e.dst FROM reach r JOIN edges e ON r.b = e.src) "
+            "SELECT * FROM reach"
+        )
+        q = stmt.query
+        assert q.mutually_recursive
+        assert q.ctes[0].name == "reach"
+
+    def test_explain(self):
+        plan = plan_statement(
+            "EXPLAIN OPTIMIZED PLAN FOR SELECT k, sum(v) FROM t GROUP BY k",
+            _catalog(),
+        )
+        assert isinstance(plan, ExplainPlan)
+        assert "Reduce" in plan.text
+
+
+class TestEndToEnd:
+    def test_groupby_sum(self):
+        got = _run(
+            "SELECT k, sum(v) FROM t GROUP BY k",
+            {
+                "t": _mk_batch(
+                    T,
+                    [np.array([1, 1, 2]), np.array([10, 20, 5])],
+                    [1, 1, 1],
+                )
+            },
+        )
+        assert got == {(1, 30): 1, (2, 5): 1}
+
+    def test_where_and_arithmetic(self):
+        got = _run(
+            "SELECT k, v * 2 + 1 FROM t WHERE v >= 10 AND k < 2",
+            {
+                "t": _mk_batch(
+                    T,
+                    [np.array([1, 1, 2]), np.array([10, 5, 50])],
+                    [1, 1, 1],
+                )
+            },
+        )
+        assert got == {(1, 21): 1}
+
+    def test_join_using(self):
+        got = _run(
+            "SELECT t.k, v, w FROM t JOIN s USING (k)",
+            {
+                "t": _mk_batch(T, [np.array([1, 2]), np.array([10, 20])],
+                               [1, 1]),
+                "s": _mk_batch(S, [np.array([1, 3]), np.array([7, 8])],
+                               [1, 1]),
+            },
+        )
+        assert got == {(1, 10, 7): 1}
+
+    def test_left_join_pads_nulls(self):
+        got = _run(
+            "SELECT t.k, w FROM t LEFT JOIN s ON t.k = s.k",
+            {
+                "t": _mk_batch(T, [np.array([1, 2]), np.array([10, 20])],
+                               [1, 1]),
+                "s": _mk_batch(S, [np.array([1]), np.array([7])], [1]),
+            },
+        )
+        # unmatched row (2, NULL): dictionary 0 for null int64 w/ mask —
+        # peek returns raw value; check row count and matched row
+        assert got[(1, 7)] == 1
+        assert sum(got.values()) == 2
+
+    def test_distinct_and_union(self):
+        got = _run(
+            "SELECT k FROM t UNION SELECT k FROM s",
+            {
+                "t": _mk_batch(T, [np.array([1, 1]), np.array([0, 0])],
+                               [1, 1]),
+                "s": _mk_batch(S, [np.array([1, 2]), np.array([0, 0])],
+                               [1, 1]),
+            },
+        )
+        assert got == {(1,): 1, (2,): 1}
+
+    def test_avg_is_sum_over_count(self):
+        got = _run(
+            "SELECT k, avg(v) FROM t GROUP BY k",
+            {
+                "t": _mk_batch(
+                    T, [np.array([1, 1]), np.array([10, 20])], [1, 1]
+                )
+            },
+        )
+        assert got == {(1, 15.0): 1}
+
+    def test_scalar_subquery_q15_shape(self):
+        got = _run(
+            "SELECT k, v FROM t WHERE v = (SELECT max(v) FROM t)",
+            {
+                "t": _mk_batch(
+                    T, [np.array([1, 2, 3]), np.array([10, 30, 30])],
+                    [1, 1, 1],
+                )
+            },
+        )
+        assert got == {(2, 30): 1, (3, 30): 1}
+
+    def test_in_subquery_semijoin(self):
+        got = _run(
+            "SELECT k, v FROM t WHERE k IN (SELECT k FROM s WHERE w > 5)",
+            {
+                "t": _mk_batch(T, [np.array([1, 2]), np.array([10, 20])],
+                               [1, 1]),
+                "s": _mk_batch(S, [np.array([1, 1, 2]),
+                                   np.array([7, 9, 1])], [1, 1, 1]),
+            },
+        )
+        assert got == {(1, 10): 1}
+
+    def test_order_by_limit_topk(self):
+        got = _run(
+            "SELECT k, v FROM t ORDER BY v DESC LIMIT 2",
+            {
+                "t": _mk_batch(
+                    T,
+                    [np.array([1, 2, 3]), np.array([10, 30, 20])],
+                    [1, 1, 1],
+                )
+            },
+        )
+        assert got == {(2, 30): 1, (3, 20): 1}
+
+    def test_wmr_transitive_closure_sql(self):
+        got = _run(
+            "WITH MUTUALLY RECURSIVE reach (a int, b int) AS ("
+            "  SELECT src, dst FROM edges"
+            "  UNION"
+            "  SELECT r.a, e.dst FROM reach r JOIN edges e ON r.b = e.src"
+            ") SELECT * FROM reach",
+            {
+                "edges": _mk_batch(
+                    E, [np.array([0, 1, 2]), np.array([1, 2, 3])],
+                    [1, 1, 1],
+                )
+            },
+        )
+        want = {(0, 1), (1, 2), (2, 3), (0, 2), (1, 3), (0, 3)}
+        assert set(got) == want
