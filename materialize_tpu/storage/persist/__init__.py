@@ -1,0 +1,34 @@
+"""persist-analog: the durable storage engine.
+
+A shard is a durable time-varying collection (persist-client/src/lib.rs:60):
+immutable columnar batch parts in a Blob store, described by a small state
+machine advanced via Consensus compare-and-set. See location.py, codec.py,
+state.py, machine.py, client.py, operators.py.
+"""
+
+from .client import PersistClient, ReadHandle, WriteHandle
+from .codec import decode_part, encode_part, part_stats
+from .location import (
+    Blob,
+    Consensus,
+    ExternalDurabilityError,
+    FileBlob,
+    MemBlob,
+    MemConsensus,
+    SqliteConsensus,
+    UnreliableBlob,
+    VersionedData,
+)
+from .machine import Fenced, Machine, UpperMismatch
+from .operators import MaintainedView, ShardSource, updates_to_batch
+from .state import HollowBatch, ShardState
+
+__all__ = [
+    "PersistClient", "ReadHandle", "WriteHandle",
+    "decode_part", "encode_part", "part_stats",
+    "Blob", "Consensus", "ExternalDurabilityError", "FileBlob", "MemBlob",
+    "MemConsensus", "SqliteConsensus", "UnreliableBlob", "VersionedData",
+    "Fenced", "Machine", "UpperMismatch",
+    "MaintainedView", "ShardSource", "updates_to_batch",
+    "HollowBatch", "ShardState",
+]

@@ -1,0 +1,221 @@
+"""Persist client: shard handles over (Blob, Consensus).
+
+Analog of ``persist-client/src/lib.rs`` + ``read.rs``/``write.rs``:
+``PersistClient.open(shard)`` yields a ``WriteHandle`` (compare-and-append
+of update batches) and a ``ReadHandle`` (snapshot at an ``as_of`` and
+``listen`` for updates beyond it). All updates are host columnar
+``(cols, nulls, time, diff)``; the dataflow bridges (operators.py) turn
+these into device batches.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time as _time
+
+import numpy as np
+
+from ...repr.batch import Batch
+from ...repr.schema import Schema
+from .codec import concat_update_parts, decode_part, encode_part
+from .location import (
+    Blob,
+    Consensus,
+    ExternalDurabilityError,
+    retry_external as _retry,
+)
+from .machine import Fenced, Machine, UpperMismatch
+
+
+class WriteHandle:
+    def __init__(self, machine: Machine, schema: Schema):
+        self.machine = machine
+        self.schema = schema
+        self.epoch = machine.register_writer()
+        self._part_seq = 0
+
+    @property
+    def upper(self) -> int:
+        return self.machine.state.upper
+
+    def compare_and_append(
+        self,
+        cols,
+        nulls,
+        time,
+        diff,
+        lower: int,
+        upper: int,
+    ) -> None:
+        """Durably append updates with times in [lower, upper); raises
+        UpperMismatch if the shard upper moved, Fenced if a newer writer
+        registered. An empty update set still advances the upper."""
+        time = np.asarray(time, np.uint64)
+        diff = np.asarray(diff, np.int64)
+        n = len(diff)
+        if n:
+            assert time.min() >= lower and time.max() < upper, (
+                "updates outside [lower, upper)"
+            )
+            keys = (self._write_part(cols, nulls, time, diff),)
+        else:
+            keys = ()
+        self.machine.compare_and_append(keys, lower, upper, n, self.epoch)
+
+    def append_batch(self, batch: Batch, lower: int, upper: int) -> None:
+        """Append a device Batch's valid rows."""
+        cols = batch.to_columns()
+        data_cols, time, diff = cols[:-2], cols[-2], cols[-1]
+        n = len(diff)
+        nulls = [
+            None if nl is None else np.asarray(nl)[:n] for nl in batch.nulls
+        ]
+        self.compare_and_append(data_cols, nulls, time, diff, lower, upper)
+
+    def _write_part(self, cols, nulls, time, diff) -> str:
+        data = encode_part(
+            self.schema,
+            [np.asarray(c) for c in cols],
+            [None if nl is None else np.asarray(nl, bool) for nl in nulls]
+            if nulls
+            else [None] * len(cols),
+            time,
+            diff,
+        )
+        self._part_seq += 1
+        key = (
+            f"{self.machine.shard}/part-e{self.epoch}-{self._part_seq}"
+        )
+        _retry(lambda: self.machine.blob.set(key, data))
+        return key
+
+
+class ReadHandle:
+    def __init__(self, machine: Machine, reader_id: str):
+        self.machine = machine
+        self.reader_id = reader_id
+        self.since = machine.register_reader(reader_id)
+
+    @property
+    def upper(self) -> int:
+        return self.machine.reload().upper
+
+    def downgrade_since(self, new_since: int) -> None:
+        self.since = max(self.since, new_since)
+        self.machine.downgrade_since(self.reader_id, new_since)
+
+    def expire(self) -> None:
+        self.machine.expire_reader(self.reader_id)
+
+    def _read_parts(self, batches):
+        schema = None
+        out = []
+        for b in batches:
+            for k in b.keys:
+                data = _retry(lambda k=k: self.machine.blob.get(k))
+                assert data is not None, f"missing part {k}"
+                sch, cols, nulls, time, diff = decode_part(data)
+                schema = schema or sch
+                out.append((cols, nulls, time, diff))
+        return schema, out
+
+    def snapshot(self, as_of: int):
+        """All updates with time <= as_of, times forwarded to as_of —
+        the definite collection at as_of (ASOF semantics,
+        doc/developer/overview.md:114-120). Requires since <= as_of <
+        upper (once readable, reads are repeatable)."""
+        st = self.machine.reload()
+        if not (st.since <= as_of < st.upper):
+            raise ValueError(
+                f"as_of {as_of} outside [since {st.since}, upper {st.upper})"
+            )
+        # Batches entirely above as_of cannot contribute: skip the fetch.
+        schema, parts = self._read_parts(
+            [b for b in st.batches if b.lower <= as_of]
+        )
+        sel = []
+        for cols, nulls, time, diff in parts:
+            m = time <= np.uint64(as_of)
+            if not m.any():
+                continue
+            sel.append(
+                (
+                    [c[m] for c in cols],
+                    [None if nl is None else nl[m] for nl in nulls],
+                    np.full(int(m.sum()), as_of, np.uint64),
+                    diff[m],
+                )
+            )
+        arity = len(sel[0][0]) if sel else 0
+        cols, nulls, time, diff = concat_update_parts(sel, arity)
+        return schema, cols, nulls, time, diff
+
+    def wait_for_upper(self, frontier: int, timeout: float = 5.0):
+        """Block until the shard upper passes ``frontier``; returns the
+        new upper or None on timeout. The polling analog of persist
+        PubSub-notified Listen (persist-client/src/rpc.rs); the
+        coordinator swaps in push notification when in-process."""
+        deadline = _time.monotonic() + timeout
+        while True:
+            st = self.machine.reload()
+            if st.upper > frontier:
+                return st.upper
+            if _time.monotonic() > deadline:
+                return None
+            _time.sleep(0.002)
+
+    def fetch(self, lo: int, hi: int):
+        """Updates with lo <= time < hi. Caller must ensure hi <= upper
+        (completeness) and lo >= since (not compacted away)."""
+        st = self.machine.reload()
+        assert hi <= st.upper, f"fetch hi {hi} beyond upper {st.upper}"
+        assert lo >= st.since or lo >= hi, (
+            f"fetch lo {lo} below since {st.since}"
+        )
+        batches = [b for b in st.batches if b.upper > lo and b.lower < hi]
+        schema, parts = self._read_parts(batches)
+        sel = []
+        for cols, nulls, time, diff in parts:
+            m = (time >= np.uint64(lo)) & (time < np.uint64(hi))
+            sel.append(
+                (
+                    [c[m] for c in cols],
+                    [None if nl is None else nl[m] for nl in nulls],
+                    time[m],
+                    diff[m],
+                )
+            )
+        arity = len(sel[0][0]) if sel else 0
+        cols, nulls, time, diff = concat_update_parts(sel, arity)
+        return schema, cols, nulls, time, diff
+
+    def listen_next(self, frontier: int, timeout: float = 5.0):
+        """Block for the upper to pass ``frontier``; returns (updates in
+        [frontier, new_upper), new_upper) or None on timeout."""
+        upper = self.wait_for_upper(frontier, timeout)
+        if upper is None:
+            return None
+        return self.fetch(frontier, upper), upper
+
+
+class PersistClient:
+    """Entry point: open shards by name over one (Blob, Consensus) pair."""
+
+    def __init__(self, blob: Blob, consensus: Consensus):
+        self.blob = blob
+        self.consensus = consensus
+        self._machines: dict[str, Machine] = {}
+        self._reader_seq = itertools.count()
+
+    def machine(self, shard: str) -> Machine:
+        if shard not in self._machines:
+            self._machines[shard] = Machine(shard, self.blob, self.consensus)
+        return self._machines[shard]
+
+    def open_writer(self, shard: str, schema: Schema) -> WriteHandle:
+        return WriteHandle(self.machine(shard), schema)
+
+    def open_reader(self, shard: str, reader_id: str | None = None) -> ReadHandle:
+        rid = reader_id or f"r{next(self._reader_seq)}-{id(self):x}"
+        return ReadHandle(self.machine(shard), rid)

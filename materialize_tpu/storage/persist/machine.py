@@ -1,0 +1,284 @@
+"""The shard state machine: all transitions via consensus CaS.
+
+Analog of ``persist-client/src/internal/machine.rs:61`` (``Machine``):
+every mutation loads the head state, computes the successor state, and
+compare-and-sets it at ``seqno + 1``; on CaS loss it reloads and
+re-evaluates (some operations then become no-ops or errors, e.g. an
+append whose expected upper no longer matches). Compaction and GC are
+the background duties (``internal/compact.rs``, ``internal/gc.rs``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+import numpy as np
+
+from .codec import concat_update_parts, decode_part, encode_part
+from .location import (
+    Blob,
+    Consensus,
+    ExternalDurabilityError,
+    VersionedData,
+    retry_external,
+)
+from .state import HollowBatch, ShardState
+
+
+class Fenced(RuntimeError):
+    """A newer writer registered; this handle must not write again."""
+
+
+class UpperMismatch(RuntimeError):
+    """compare_and_append expected a different shard upper."""
+
+    def __init__(self, expected: int, actual: int):
+        super().__init__(f"expected upper {expected}, shard at {actual}")
+        self.expected = expected
+        self.actual = actual
+
+
+class Machine:
+    def __init__(self, shard: str, blob: Blob, consensus: Consensus):
+        self.shard = shard
+        self.blob = blob
+        self.consensus = consensus
+        self._state = self._load_or_init()
+
+    # -- state plumbing ----------------------------------------------------
+    def _load_or_init(self) -> ShardState:
+        head = self.consensus.head(self.shard)
+        if head is not None:
+            return ShardState.from_bytes(head.data)
+        init = ShardState(shard=self.shard)
+        if self.consensus.compare_and_set(
+            self.shard, None, VersionedData(0, init.to_bytes())
+        ):
+            return init
+        return ShardState.from_bytes(self.consensus.head(self.shard).data)
+
+    def reload(self) -> ShardState:
+        head = self.consensus.head(self.shard)
+        assert head is not None
+        self._state = ShardState.from_bytes(head.data)
+        return self._state
+
+    @property
+    def state(self) -> ShardState:
+        return self._state
+
+    def _apply(self, f):
+        """CaS loop: state -> (new_state | None, result). None = no-op.
+        Reloads the head each attempt: transition errors (Fenced,
+        UpperMismatch) must be judged against the current state, not a
+        stale cache — a fenced writer with a stale cache would otherwise
+        see UpperMismatch instead of Fenced."""
+        while True:
+            st = self.reload()
+            new, result = f(st)
+            if new is None:
+                return result
+            new = replace(new, seqno=st.seqno + 1)
+            if self.consensus.compare_and_set(
+                self.shard, st.seqno, VersionedData(new.seqno, new.to_bytes())
+            ):
+                self._state = new
+                return result
+            self.reload()
+
+    # -- transitions -------------------------------------------------------
+    def register_writer(self) -> int:
+        """Claim the write epoch, fencing all previous writers
+        (``ComputeCommand::Hello{nonce}`` / persist writer-fencing analog)."""
+
+        def f(st):
+            epoch = st.writer_epoch + 1
+            return replace(st, writer_epoch=epoch), epoch
+
+        return self._apply(f)
+
+    def compare_and_append(
+        self,
+        keys: tuple[str, ...],
+        lower: int,
+        upper: int,
+        n_updates: int,
+        epoch: int,
+    ) -> None:
+        """Append a batch [lower, upper) iff lower == shard upper and the
+        caller still holds the current write epoch."""
+        assert upper > lower, (lower, upper)
+
+        def f(st):
+            if epoch != st.writer_epoch:
+                raise Fenced(
+                    f"epoch {epoch} fenced by {st.writer_epoch}"
+                )
+            if lower != st.upper:
+                raise UpperMismatch(lower, st.upper)
+            batch = HollowBatch(lower, upper, tuple(keys), n_updates)
+            return (
+                replace(st, upper=upper, batches=st.batches + (batch,)),
+                None,
+            )
+
+        self._apply(f)
+
+    def register_reader(self, reader_id: str) -> int:
+        """Install a read hold at the current since; returns that since."""
+
+        def f(st):
+            holds = dict(st.reader_holds)
+            if reader_id in holds:
+                return None, holds[reader_id]
+            holds[reader_id] = st.since
+            return (
+                replace(st, reader_holds=tuple(sorted(holds.items()))),
+                st.since,
+            )
+
+        return self._apply(f)
+
+    def downgrade_since(self, reader_id: str, new_since: int) -> int:
+        """Advance one reader's hold; shard since = min over holds.
+        Returns the resulting shard since."""
+
+        def f(st):
+            holds = dict(st.reader_holds)
+            cur = holds.get(reader_id, st.since)
+            holds[reader_id] = max(cur, new_since)
+            since = min(holds.values()) if holds else max(
+                st.since, new_since
+            )
+            since = max(since, st.since)
+            return (
+                replace(
+                    st,
+                    since=since,
+                    reader_holds=tuple(sorted(holds.items())),
+                ),
+                since,
+            )
+
+        return self._apply(f)
+
+    def expire_reader(self, reader_id: str) -> None:
+        def f(st):
+            holds = dict(st.reader_holds)
+            if reader_id not in holds:
+                return None, None
+            del holds[reader_id]
+            since = min(holds.values()) if holds else st.since
+            return (
+                replace(
+                    st,
+                    since=max(st.since, since),
+                    reader_holds=tuple(sorted(holds.items())),
+                ),
+                None,
+            )
+
+        self._apply(f)
+
+    # -- background duties -------------------------------------------------
+    def maybe_compact(self, max_batches: int = 8) -> int:
+        """Merge all current batches into one when the spine grows past
+        ``max_batches``: reads parts, forwards times to ``since`` (logical
+        compaction), consolidates, writes one merged part, swaps it in,
+        then deletes the replaced parts. Returns #parts replaced.
+
+        Concurrency: the swap requires the EXACT batch prefix that was
+        merged to still be present (identity on the HollowBatch tuple) —
+        a racing compaction that replaced any of those batches makes this
+        one a no-op (its merged part is discarded), so no appended or
+        concurrently-compacted data can be dropped."""
+        st = self.reload()
+        if len(st.batches) <= max_batches:
+            return 0
+        prefix = st.batches
+        merged_key, n, old_keys = self._merge_parts(st)
+        lower = prefix[0].lower
+        upper = prefix[-1].upper
+
+        def f(cur):
+            if cur.batches[: len(prefix)] != prefix:
+                return None, 0  # lost the race; discard our merge
+            keep = cur.batches[len(prefix):]
+            batch = HollowBatch(lower, upper, (merged_key,) if n else (), n)
+            return replace(cur, batches=(batch,) + keep), len(old_keys)
+
+        replaced = self._apply(f)
+        # Best-effort blob cleanup: state is already durable; a failed
+        # delete leaks a part but never corrupts (internal/gc.rs model).
+        doomed = old_keys if replaced else ([merged_key] if n else [])
+        for k in doomed:
+            try:
+                retry_external(lambda k=k: self.blob.delete(k))
+            except ExternalDurabilityError:
+                pass
+        return replaced
+
+    def _merge_parts(self, st: ShardState):
+        """Read every part, forward times to since, consolidate, write
+        one part. Host-side numpy work (a background task in the
+        reference's compaction pool, internal/compact.rs)."""
+        schema = None
+        parts = []
+        old_keys = []
+        for b in st.batches:
+            for k in b.keys:
+                old_keys.append(k)
+                data = retry_external(lambda k=k: self.blob.get(k))
+                assert data is not None, f"missing blob part {k}"
+                sch, cols, nulls, time, diff = decode_part(data)
+                schema = schema or sch
+                parts.append((cols, nulls, time, diff))
+        if schema is None:
+            return "", 0, old_keys
+        cols, nulls, time, diff = concat_update_parts(
+            parts, len(schema.columns)
+        )
+        # Logical compaction: forward every time to the since frontier.
+        time = np.maximum(time, np.uint64(st.since))
+        # Consolidate: sum diffs of identical (row, time); drop zeros.
+        key_cols = [c for c in cols] + [
+            nl if nl is not None else np.zeros(len(time), np.bool_)
+            for nl in nulls
+        ] + [time]
+        order = np.lexsort(key_cols[::-1]) if len(time) else np.arange(0)
+        cols = [c[order] for c in cols]
+        nulls = [nl[order] if nl is not None else None for nl in nulls]
+        time, diff = time[order], diff[order]
+        if len(time):
+            same = np.ones(len(time), np.bool_)
+            same[0] = False
+            for kc in key_cols:
+                kc = kc[order]
+                same[1:] &= kc[1:] == kc[:-1]
+            group = np.cumsum(~same) - 1
+            sums = np.zeros(group[-1] + 1, DIFF := diff.dtype)
+            np.add.at(sums, group, diff)
+            firsts = np.nonzero(~same)[0]
+            keep = sums != 0
+            sel = firsts[keep]
+            cols = [c[sel] for c in cols]
+            nulls = [nl[sel] if nl is not None else None for nl in nulls]
+            time = time[sel]
+            diff = sums[keep]
+        n = len(time)
+        if n == 0:
+            return "", 0, old_keys
+        merged_key = f"{self.shard}/compact-{st.seqno}-{st.upper}"
+        self.blob.set(
+            merged_key, encode_part(schema, cols, nulls, time, diff)
+        )
+        return merged_key, n, old_keys
+
+    def gc_consensus(self, keep_last: int = 1) -> None:
+        """Truncate consensus history below the head (state GC,
+        ``internal/gc.rs``): old seqnos are only needed for debugging."""
+        head = self.consensus.head(self.shard)
+        if head is not None and head.seqno >= keep_last:
+            self.consensus.truncate(
+                self.shard, head.seqno - keep_last + 1
+            )

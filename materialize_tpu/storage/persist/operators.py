@@ -1,0 +1,196 @@
+"""Dataflow <-> shard bridges: shard_source and persist_sink.
+
+Analog of ``storage-operators/src/persist_source.rs`` (shard -> dataflow
+import, consumed at ``compute/src/render.rs:291``) and the MV persist
+sink (``compute/src/sink/materialized_view.rs``): a ``MaintainedView``
+reads update chunks from input shards, advances the dataflow one
+micro-batch step per chunk, and compare-and-appends the output delta to
+the view's shard. Resume is the reference's model exactly (SURVEY.md §5
+checkpoint/resume): NO operator-state checkpoint — on restart the
+dataflow re-renders and re-hydrates from input-shard snapshots at the
+output shard's upper.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...render.dataflow import Dataflow
+from ...repr.batch import Batch, capacity_tier
+from ...repr.schema import Schema
+from .client import PersistClient, ReadHandle, WriteHandle
+
+
+def updates_to_batch(
+    schema: Schema, cols, nulls, time, diff, as_of: int,
+    capacity: int | None = None,
+) -> Batch:
+    """Host update arrays -> device Batch with times forwarded to as_of
+    (the step processes one virtual timestamp; logical compaction)."""
+    n = len(diff)
+    return Batch.from_numpy(
+        schema,
+        cols,
+        np.full(n, as_of, np.uint64),
+        diff,
+        capacity=capacity,
+        nulls=nulls,
+    )
+
+
+class ShardSource:
+    """Import one shard into a dataflow: snapshot + listen chunks
+    (persist_source analog)."""
+
+    def __init__(self, reader: ReadHandle, schema: Schema):
+        self.reader = reader
+        self.schema = schema
+        self.frontier: int | None = None  # set by snapshot()/resume_at()
+
+    def snapshot(self, as_of: int) -> "tuple[Batch, int]":
+        _sch, cols, nulls, time, diff = self.reader.snapshot(as_of)
+        self.frontier = as_of + 1
+        return (
+            updates_to_batch(self.schema, cols, nulls, time, diff, as_of),
+            as_of,
+        )
+
+    def resume_at(self, frontier: int) -> None:
+        self.frontier = frontier
+
+    def poll(self, timeout: float = 5.0):
+        """Next chunk beyond the frontier, forwarded to the chunk's last
+        time. Returns (batch, chunk_time, new_frontier) or None."""
+        assert self.frontier is not None, "snapshot()/resume_at() first"
+        got = self.reader.listen_next(self.frontier, timeout)
+        if got is None:
+            return None
+        (_sch, cols, nulls, time, diff), new_upper = got
+        t = new_upper - 1
+        batch = updates_to_batch(self.schema, cols, nulls, time, diff, t)
+        self.frontier = new_upper
+        return batch, t, new_upper
+
+    def fetch_to(self, target: int) -> Batch:
+        """Chunk [frontier, target), forwarded to target-1. Caller must
+        have confirmed target <= shard upper."""
+        assert self.frontier is not None and target > self.frontier - 1
+        _sch, cols, nulls, time, diff = self.reader.fetch(
+            self.frontier, target
+        )
+        batch = updates_to_batch(
+            self.schema, cols, nulls, time, diff, target - 1
+        )
+        self.frontier = target
+        return batch
+
+
+class MaintainedView:
+    """An installed dataflow maintained between shards: sources -> step ->
+    output shard. One shard per source name; the output shard's upper is
+    the view's write frontier (sink/materialized_view_v2.rs analog —
+    self-correcting via compare-and-append: on restart a partially
+    written step is retried exactly because the upper didn't advance)."""
+
+    def __init__(
+        self,
+        client: PersistClient,
+        dataflow: Dataflow,
+        source_shards: dict[str, tuple[str, Schema]],
+        output_shard: str,
+    ):
+        self.client = client
+        self.df = dataflow
+        self.sources = {
+            name: ShardSource(client.open_reader(shard), schema)
+            for name, (shard, schema) in source_shards.items()
+        }
+        self.writer: WriteHandle = client.open_writer(
+            output_shard, dataflow.out_schema
+        )
+        self.hydrate()
+
+    # -- rehydration -------------------------------------------------------
+    def hydrate(self) -> None:
+        """Bring the dataflow to the output shard's upper: snapshot every
+        input at as_of = upper-1 (or the inputs' max since if the output
+        is empty), run one step, append the initial output if needed."""
+        out_upper = self.writer.upper
+        if out_upper == 0:
+            as_of = max(
+                s.reader.machine.reload().since
+                for s in self.sources.values()
+            )
+            # Inputs must be readable at as_of; wait for uppers to pass
+            # (as-of selection, compute-client/src/as_of_selection.rs).
+            for s in self.sources.values():
+                if s.reader.wait_for_upper(as_of, timeout=30.0) is None:
+                    raise TimeoutError(
+                        "input shard upper never passed hydration as_of "
+                        f"{as_of}"
+                    )
+            inputs = {}
+            for name, s in self.sources.items():
+                b, _ = s.snapshot(as_of)
+                inputs[name] = b
+            self.df.time = as_of
+            self.df.step(inputs)
+            out = self._output_snapshot_delta()
+            self._append(out, 0, as_of + 1, as_of)
+        else:
+            as_of = out_upper - 1
+            inputs = {}
+            for name, s in self.sources.items():
+                b, _ = s.snapshot(as_of)
+                inputs[name] = b
+            self.df.time = as_of
+            self.df.step(inputs)  # rebuild arrangements; output delta
+            # already durable — do NOT append.
+
+    def _output_snapshot_delta(self) -> Batch:
+        # After hydration the output arrangement IS the initial delta.
+        return self.df.output.batch
+
+    def _append(self, batch: Batch, lower: int, upper: int, t: int) -> None:
+        cols = batch.to_columns()
+        data_cols, _time, diff = cols[:-2], cols[-2], cols[-1]
+        n = len(diff)
+        nulls = [
+            None if nl is None else np.asarray(nl)[:n] for nl in batch.nulls
+        ]
+        self.writer.compare_and_append(
+            data_cols, nulls, np.full(n, t, np.uint64), diff, lower, upper
+        )
+
+    # -- steady state ------------------------------------------------------
+    def step(self, timeout: float = 5.0) -> bool:
+        """Process all sources' updates up to a COMMON target frontier
+        (min over input uppers beyond our own): the micro-batch analog of
+        frontier-joined progress. Returns False if the inputs did not
+        advance within the timeout."""
+        lower = self.writer.upper
+        target = None
+        for s in self.sources.values():
+            upper = s.reader.wait_for_upper(lower, timeout)  # > lower
+            if upper is None:
+                return False
+            target = upper if target is None else min(target, upper)
+        polled = {
+            name: s.fetch_to(target) for name, s in self.sources.items()
+        }
+        t = target - 1
+        self.df.time = t
+        out = self.df.step(polled)
+        self._append(out, lower, target, t)
+        return True
+
+    def run_until(self, frontier: int, timeout: float = 30.0) -> None:
+        """Advance until the output upper reaches ``frontier``."""
+        while self.writer.upper < frontier:
+            if not self.step(timeout):
+                raise TimeoutError(
+                    f"sources stalled below frontier {frontier}"
+                )
+
+    def peek(self) -> list[tuple]:
+        return self.df.peek()
