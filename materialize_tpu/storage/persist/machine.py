@@ -241,30 +241,34 @@ class Machine:
         # Logical compaction: forward every time to the since frontier.
         time = np.maximum(time, np.uint64(st.since))
         # Consolidate: sum diffs of identical (row, time); drop zeros.
-        key_cols = [c for c in cols] + [
-            nl if nl is not None else np.zeros(len(time), np.bool_)
+        # Native C++ kernel; float keys grouped by bit pattern (any total
+        # order works for grouping), null masks as extra key columns.
+        from ... import native
+
+        def as_key(c):
+            if c.dtype == np.int64:
+                return c
+            if c.dtype == np.float64:
+                # Normalize -0.0 to +0.0 so a retraction computed with the
+                # other zero's bit pattern still cancels; NaNs group by
+                # bit pattern, which is stricter than float equality (a
+                # NaN never equals itself) and thus still cancels exact
+                # re-derivations.
+                return np.where(c == 0.0, 0.0, c).view(np.int64)
+            return c.astype(np.int64)
+
+        key_cols = [as_key(c) for c in cols]
+        key_cols += [
+            (
+                nl if nl is not None else np.zeros(len(time), np.bool_)
+            ).astype(np.int64)
             for nl in nulls
-        ] + [time]
-        order = np.lexsort(key_cols[::-1]) if len(time) else np.arange(0)
-        cols = [c[order] for c in cols]
-        nulls = [nl[order] if nl is not None else None for nl in nulls]
-        time, diff = time[order], diff[order]
-        if len(time):
-            same = np.ones(len(time), np.bool_)
-            same[0] = False
-            for kc in key_cols:
-                kc = kc[order]
-                same[1:] &= kc[1:] == kc[:-1]
-            group = np.cumsum(~same) - 1
-            sums = np.zeros(group[-1] + 1, DIFF := diff.dtype)
-            np.add.at(sums, group, diff)
-            firsts = np.nonzero(~same)[0]
-            keep = sums != 0
-            sel = firsts[keep]
-            cols = [c[sel] for c in cols]
-            nulls = [nl[sel] if nl is not None else None for nl in nulls]
-            time = time[sel]
-            diff = sums[keep]
+        ]
+        key_cols.append(time.astype(np.int64))
+        sel, diff = native.consolidate_i64(key_cols, diff)
+        cols = [c[sel] for c in cols]
+        nulls = [nl[sel] if nl is not None else None for nl in nulls]
+        time = time[sel]
         n = len(time)
         if n == 0:
             return "", 0, old_keys
