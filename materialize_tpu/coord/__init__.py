@@ -1,0 +1,2 @@
+"""Control plane: CTP-analog protocol, replica workers, compute
+controller, timestamp oracle, coordinator (SURVEY.md layers L1/L4/L7)."""

@@ -1,0 +1,281 @@
+"""Compute controller: command history, replica clients, rehydration.
+
+Analog of ``compute-client/src/controller.rs`` + ``controller/replica.rs``:
+the controller owns the desired state (an append-only command history,
+compacted like ``protocol/history.rs``), fans every command out to every
+replica of the instance, and on replica failure reconnects and replays
+the compacted history — the replica reconciles, keeping unchanged
+dataflows (rehydration, ``controller/instance.rs:1379 rehydrate_failed_
+replicas``). Multi-replica peek responses are deduplicated: first
+response wins (``service.rs:271 absorb_peek_response``). Active-active
+replication is exactly this: run >=2 replicas, mask failures.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import socket
+import threading
+import time as _time
+from collections import deque
+
+from . import protocol as ctp
+from .protocol import DataflowDescription
+
+
+class ReplicaClient:
+    """Background connection owner for one replica: connect, Hello,
+    replay history, then stream commands; responses land in the
+    controller's shared queue tagged with the replica name."""
+
+    def __init__(
+        self,
+        name: str,
+        addr: tuple[str, int],
+        history_fn,
+        response_q: queue.Queue,
+        nonce_counter,
+    ):
+        self.name = name
+        self.addr = addr
+        self._history_fn = history_fn
+        self._response_q = response_q
+        self._nonce_counter = nonce_counter
+        self._cmd_q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self.connected = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def send(self, cmd: dict) -> None:
+        self._cmd_q.put(cmd)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- connection loop ----------------------------------------------------
+    def _run(self) -> None:
+        backoff = 0.05
+        while not self._stop.is_set():
+            try:
+                self._session()
+                backoff = 0.05
+            except (OSError, ctp.TransportError):
+                pass
+            self.connected.clear()
+            if not self._stop.is_set():
+                _time.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+
+    def _session(self) -> None:
+        sock = socket.create_connection(self.addr, timeout=5.0)
+        try:
+            sock.settimeout(None)
+            nonce = next(self._nonce_counter)
+            ctp.send_msg(sock, ctp.hello(nonce))
+            resp = ctp.recv_msg(sock)
+            if resp.get("kind") != "HelloOk":
+                raise ctp.TransportError(f"hello rejected: {resp}")
+            # Rehydration: replay the compacted history. The replica
+            # reconciles (keeps unchanged dataflows) and drops the rest.
+            history, live = self._history_fn()
+            for name in resp.get("installed", []):
+                if name not in live:
+                    ctp.send_msg(sock, ctp.drop_dataflow(name))
+            for cmd in history:
+                ctp.send_msg(sock, cmd)
+            self.connected.set()
+
+            dead = threading.Event()
+
+            def reader():
+                try:
+                    while not dead.is_set():
+                        msg = ctp.recv_msg(sock)
+                        msg["__replica__"] = self.name
+                        self._response_q.put(msg)
+                except (OSError, ctp.TransportError):
+                    dead.set()
+
+            t = threading.Thread(target=reader, daemon=True)
+            t.start()
+            while not self._stop.is_set() and not dead.is_set():
+                try:
+                    cmd = self._cmd_q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                ctp.send_msg(sock, cmd)
+            if dead.is_set():
+                raise ctp.TransportError("replica connection lost")
+        finally:
+            sock.close()
+
+
+class ComputeController:
+    """Desired-state owner for one compute instance (cluster)."""
+
+    def __init__(self):
+        self._nonce_counter = itertools.count(1)
+        self._peek_counter = itertools.count(1)
+        self.responses: queue.Queue = queue.Queue()
+        self.replicas: dict[str, ReplicaClient] = {}
+        # Command history, compacted: dataflow name -> CreateDataflow cmd
+        # (a dropped dataflow disappears entirely: history.rs compaction).
+        self._dataflows: dict[str, dict] = {}
+        self._config: dict = {}
+        self._lock = threading.Lock()
+        # Observed state (guarded by _lock: mutated by the absorber
+        # thread, read by caller threads).
+        self.frontiers: dict[str, dict[str, int]] = {}  # df -> replica -> upper
+        self.statuses: deque = deque(maxlen=1000)  # replica error reports
+        self._peek_results: dict[int, dict] = {}
+        self._peek_events: dict[int, threading.Event] = {}
+        self._absorber = threading.Thread(
+            target=self._absorb_responses, daemon=True
+        )
+        self._stop = threading.Event()
+        self._absorber.start()
+
+    # -- replica management --------------------------------------------------
+    def add_replica(self, name: str, addr: tuple[str, int]) -> None:
+        """Provision a replica (cluster-controller ensure_service analog);
+        it will connect, receive the history, and hydrate."""
+        self.replicas[name] = ReplicaClient(
+            name, addr, self._history_snapshot, self.responses,
+            self._nonce_counter,
+        )
+
+    def drop_replica(self, name: str) -> None:
+        rc = self.replicas.pop(name, None)
+        if rc is not None:
+            rc.stop()
+        with self._lock:
+            for per_df in self.frontiers.values():
+                per_df.pop(name, None)
+
+    def _history_snapshot(self):
+        with self._lock:
+            history = []
+            if self._config:
+                history.append(ctp.update_configuration(dict(self._config)))
+            history.extend(self._dataflows.values())
+            return history, set(self._dataflows)
+
+    def _broadcast(self, cmd: dict) -> None:
+        for rc in self.replicas.values():
+            rc.send(cmd)
+
+    # -- commands -------------------------------------------------------------
+    def create_dataflow(self, desc: DataflowDescription) -> None:
+        cmd = ctp.create_dataflow(desc)
+        with self._lock:
+            self._dataflows[desc.name] = cmd
+        self._broadcast(cmd)
+
+    def drop_dataflow(self, name: str) -> None:
+        with self._lock:
+            self._dataflows.pop(name, None)
+            self.frontiers.pop(name, None)
+        self._broadcast(ctp.drop_dataflow(name))
+
+    def allow_compaction(self, dataflow: str, since: int) -> None:
+        self._broadcast(ctp.allow_compaction(dataflow, since))
+
+    def update_configuration(self, params: dict) -> None:
+        with self._lock:
+            self._config.update(params)
+        self._broadcast(ctp.update_configuration(params))
+
+    def peek(
+        self, dataflow: str, as_of: int | None, timeout: float = 30.0
+    ):
+        """Peek on every replica; first response wins
+        (absorb_peek_response). Returns (rows, served_at)."""
+        peek_id = next(self._peek_counter)
+        ev = threading.Event()
+        self._peek_events[peek_id] = ev
+        self._broadcast(ctp.peek(peek_id, dataflow, as_of))
+        try:
+            if not ev.wait(timeout):
+                raise TimeoutError(
+                    f"peek {peek_id} on {dataflow!r} timed out"
+                )
+            with self._lock:
+                resp = self._peek_results.pop(peek_id)
+            if "error" in resp:
+                raise RuntimeError(resp["error"])
+            return resp["rows"], resp["served_at"]
+        finally:
+            # Event first, then any straggler result, both under the
+            # absorber's lock: later duplicate responses cannot leak.
+            with self._lock:
+                self._peek_events.pop(peek_id, None)
+                self._peek_results.pop(peek_id, None)
+            self._broadcast(ctp.cancel_peek(peek_id))
+
+    # -- response absorption ---------------------------------------------------
+    def _absorb_responses(self) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = self.responses.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            kind = msg.get("kind")
+            if kind == "Frontiers":
+                replica = msg["__replica__"]
+                with self._lock:
+                    # A dropped replica may still have queued reports:
+                    # discard them or they pin the definite frontier.
+                    if replica in self.replicas:
+                        for df, upper in msg["uppers"].items():
+                            self.frontiers.setdefault(df, {})[
+                                replica
+                            ] = upper
+            elif kind == "Status":
+                with self._lock:
+                    self.statuses.append(msg)
+            elif kind == "PeekResponse":
+                pid = msg["peek_id"]
+                with self._lock:
+                    ev = self._peek_events.get(pid)
+                    if ev is not None and pid not in self._peek_results:
+                        self._peek_results[pid] = msg  # first wins
+                        ev.set()
+
+    # -- observed state --------------------------------------------------------
+    def frontier(self, dataflow: str) -> int:
+        """The definite frontier: MIN over ALL replicas of the instance —
+        a replica that has not reported yet (still hydrating) counts as
+        0, so the definite frontier never overstates."""
+        with self._lock:
+            if not self.replicas:
+                return 0
+            per = self.frontiers.get(dataflow, {})
+            return min(per.get(name, 0) for name in self.replicas)
+
+    def any_frontier(self, dataflow: str) -> int:
+        """The serving frontier: MAX over replicas (some replica can
+        answer at this time)."""
+        with self._lock:
+            per = self.frontiers.get(dataflow)
+            return max(per.values()) if per else 0
+
+    def wait_frontier(
+        self, dataflow: str, past: int, timeout: float = 30.0
+    ) -> int:
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            f = self.any_frontier(dataflow)
+            if f > past:
+                return f
+            _time.sleep(0.005)
+        raise TimeoutError(
+            f"frontier of {dataflow!r} stuck at "
+            f"{self.any_frontier(dataflow)} (wanted > {past})"
+        )
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for rc in self.replicas.values():
+            rc.stop()

@@ -1,0 +1,151 @@
+"""Control-plane transport: the CTP analog.
+
+The reference's CTP (``service/src/transport.rs:10-21``) is
+bincode-serialized, length-prefixed messages with heartbeating over
+TCP/UDS, single active client per server, nonce-based epoch fencing
+(``ComputeCommand::Hello`` ``protocol/command.rs:45-53``). The analog
+here: length-prefixed frames carrying pickled command/response dicts with
+a native CRC32C integrity check, over TCP; one active controller per
+replica; a strictly increasing ``nonce`` fences stale controllers.
+
+Pickle is the bincode analog for this *internal, trusted* link between
+our own processes (never exposed to users; the SQL front end has its own
+wire protocol).
+
+Command set (``compute-client/src/protocol/command.rs:38-45``):
+  Hello{nonce}, CreateInstance, CreateDataflow, Schedule, Peek,
+  CancelPeek, AllowCompaction, UpdateConfiguration
+Response set (``protocol/response.rs:29``):
+  HelloOk/HelloReject, Frontiers, PeekResponse, SubscribeResponse, Status
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import socket
+import struct
+from dataclasses import dataclass, field
+from typing import Any
+
+from .. import native
+
+FRAME_MAGIC = b"MTC1"
+MAX_FRAME = 1 << 30
+
+
+class TransportError(RuntimeError):
+    pass
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    header = FRAME_MAGIC + struct.pack(
+        "<II", len(payload), native.crc32c(payload)
+    )
+    sock.sendall(header + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = io.BytesIO()
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            raise TransportError("connection closed")
+        buf.write(chunk)
+        got += len(chunk)
+    return buf.getvalue()
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    header = _recv_exact(sock, 12)
+    if header[:4] != FRAME_MAGIC:
+        raise TransportError("bad frame magic")
+    length, crc = struct.unpack("<II", header[4:])
+    if length > MAX_FRAME:
+        raise TransportError(f"oversized frame: {length}")
+    payload = _recv_exact(sock, length)
+    if native.crc32c(payload) != crc:
+        raise TransportError("frame crc mismatch")
+    return payload
+
+
+def send_msg(sock: socket.socket, msg: Any) -> None:
+    send_frame(sock, pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL))
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    return pickle.loads(recv_frame(sock))
+
+
+# ---------------------------------------------------------------------------
+# Dataflow descriptions shipped over the wire
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PersistLocation:
+    """Where a replica finds the durability substrate (subprocess-able
+    config; in-process tests may inject client objects instead)."""
+
+    blob_root: str
+    consensus_path: str
+
+
+@dataclass(frozen=True)
+class DataflowDescription:
+    """What to build (compute-types/src/dataflows.rs:32 analog): MIR to
+    render, source shard imports, and exports — an index (peekable
+    in-replica arrangement) and/or an MV sink shard."""
+
+    name: str
+    expr: Any  # mir.RelationExpr
+    source_imports: dict  # input name -> (shard_name, Schema)
+    sink_shard: str | None = None
+
+    def fingerprint(self) -> bytes:
+        return pickle.dumps(
+            (
+                self.name,
+                self.expr,
+                sorted(self.source_imports.items()),
+                self.sink_shard,
+            ),
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Command / response constructors (dicts keep the wire format trivial)
+# ---------------------------------------------------------------------------
+
+
+def hello(nonce: int) -> dict:
+    return {"kind": "Hello", "nonce": nonce}
+
+
+def create_dataflow(desc: DataflowDescription) -> dict:
+    return {"kind": "CreateDataflow", "desc": desc}
+
+
+def drop_dataflow(name: str) -> dict:
+    return {"kind": "DropDataflow", "name": name}
+
+
+def peek(peek_id: int, dataflow: str, as_of: int | None) -> dict:
+    return {
+        "kind": "Peek", "peek_id": peek_id, "dataflow": dataflow,
+        "as_of": as_of,
+    }
+
+
+def cancel_peek(peek_id: int) -> dict:
+    return {"kind": "CancelPeek", "peek_id": peek_id}
+
+
+def allow_compaction(dataflow: str, since: int) -> dict:
+    return {"kind": "AllowCompaction", "dataflow": dataflow, "since": since}
+
+
+def update_configuration(params: dict) -> dict:
+    return {"kind": "UpdateConfiguration", "params": params}

@@ -1,0 +1,387 @@
+"""Replica worker process: the clusterd analog.
+
+One process = one replica of a cluster (``clusterd/src/lib.rs:190``): it
+hosts the compute runtime (installed dataflows stepped as TPU
+micro-batches) and the storage runtime (shard sources/sinks). A single
+controller connection is active at a time; a strictly-increasing Hello
+nonce fences stale controllers (``cluster/src/communication.rs`` epoch
+protocol + ``protocol/command.rs:45-53``). On reconnect the controller
+replays its command history; reconciliation keeps dataflows whose
+description is unchanged instead of rebuilding them
+(``compute/src/server.rs:373 run_client``).
+
+Run as a subprocess:
+    python -m materialize_tpu.coord.replica --port P --blob DIR \
+        --consensus FILE [--replica-id R]
+"""
+
+from __future__ import annotations
+
+import argparse
+import queue
+import socket
+import threading
+import time as _time
+
+from ..render.dataflow import Dataflow
+from ..storage.persist import (
+    FileBlob,
+    MaintainedView,
+    PersistClient,
+    SqliteConsensus,
+)
+from ..storage.persist.machine import Fenced
+from ..storage.persist.operators import SinkConflict
+from . import protocol as ctp
+from .protocol import DataflowDescription, PersistLocation
+
+
+class _Installed:
+    """A running dataflow + its shipped description fingerprint (for
+    reconciliation) and read-hold bookkeeping."""
+
+    def __init__(self, desc: DataflowDescription, view: MaintainedView):
+        self.desc = desc
+        self.fingerprint = desc.fingerprint()
+        self.view = view
+        self.reported_upper = -1
+
+
+class ReplicaWorker:
+    def __init__(
+        self,
+        location: PersistLocation | None = None,
+        persist_client: PersistClient | None = None,
+        replica_id: str = "r0",
+    ):
+        if persist_client is not None:
+            self.client = persist_client
+        else:
+            assert location is not None
+            self.client = PersistClient(
+                FileBlob(location.blob_root),
+                SqliteConsensus(location.consensus_path),
+            )
+        self.replica_id = replica_id
+        self.epoch = -1
+        self.dataflows: dict[str, _Installed] = {}
+        self.pending_peeks: list[dict] = []
+        self.config: dict = {}
+        self._stop = threading.Event()
+
+    # -- serving -------------------------------------------------------------
+    def serve(self, listen_sock: socket.socket) -> None:
+        """One active controller session at a time; a NEW connection with
+        a higher nonce preempts the current session immediately (the
+        reference's single-client-at-a-time servers where a reconnecting
+        controller takes over, transport.rs:10-21)."""
+        listen_sock.settimeout(0.2)
+        session_q: queue.Queue = queue.Queue()
+
+        def acceptor():
+            while not self._stop.is_set():
+                try:
+                    conn, _addr = listen_sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                try:
+                    conn.settimeout(5.0)
+                    msg = ctp.recv_msg(conn)
+                    if (
+                        msg.get("kind") != "Hello"
+                        or msg["nonce"] <= self.epoch
+                    ):
+                        ctp.send_msg(
+                            conn,
+                            {"kind": "HelloReject", "epoch": self.epoch},
+                        )
+                        conn.close()
+                        continue
+                    nonce = msg["nonce"]
+                    # Fences the running session: its loop observes the
+                    # epoch change and exits.
+                    self.epoch = nonce
+                    conn.settimeout(None)
+                    ctp.send_msg(
+                        conn,
+                        {
+                            "kind": "HelloOk",
+                            "epoch": nonce,
+                            "replica_id": self.replica_id,
+                            # Reconciliation: what we still have running.
+                            "installed": sorted(self.dataflows),
+                        },
+                    )
+                    session_q.put((conn, nonce))
+                except Exception:
+                    # A malformed hello (bad pickle, non-dict) must not
+                    # kill the acceptor — the replica would stop
+                    # accepting controllers forever.
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+
+        threading.Thread(target=acceptor, daemon=True).start()
+        while not self._stop.is_set():
+            try:
+                conn, nonce = session_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if nonce != self.epoch:
+                conn.close()  # superseded while queued
+                continue
+            try:
+                self._serve_session(conn, nonce)
+            except Exception:
+                # The session dies, the replica survives: the controller
+                # reconnects and replays history (rehydration).
+                pass
+            finally:
+                conn.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _serve_session(self, conn: socket.socket, nonce: int) -> None:
+        cmd_q: queue.Queue = queue.Queue()
+        dead = threading.Event()
+
+        def reader():
+            try:
+                while not dead.is_set():
+                    cmd_q.put(ctp.recv_msg(conn))
+            except (ctp.TransportError, OSError):
+                dead.set()
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        try:
+            self._worker_loop(conn, cmd_q, dead, nonce)
+        finally:
+            dead.set()
+
+    # -- the worker loop ------------------------------------------------------
+    def _worker_loop(self, conn, cmd_q, dead, nonce) -> None:
+        """Single-threaded compute loop: drain commands, step dataflows,
+        serve ready peeks, report frontiers (run()/step_or_park,
+        compute/src/server.rs:356)."""
+        while not dead.is_set() and not self._stop.is_set():
+            if self.epoch != nonce:
+                return  # fenced by a newer controller
+            worked = False
+            try:
+                while True:
+                    cmd = cmd_q.get_nowait()
+                    try:
+                        self._handle_command(conn, cmd)
+                    except Exception as e:
+                        # A failing command must not kill the session.
+                        self._send_status(
+                            conn, f"command {cmd.get('kind')} failed: {e!r}"
+                        )
+                    worked = True
+            except queue.Empty:
+                pass
+            for name, inst in list(self.dataflows.items()):
+                try:
+                    # Non-blocking step: only if some input advanced.
+                    if inst.view.step(timeout=0):
+                        worked = True
+                except SinkConflict:
+                    # Another replica's durable chunking won a hydration
+                    # race: rebuild this view from the durable shard
+                    # (fresh dataflow state; hydrate resumes exactly).
+                    inst.view.expire()
+                    self.dataflows[name] = self._build(inst.desc)
+                    worked = True
+                except Exception as e:  # halt!-analog, scoped to the df
+                    self.dataflows.pop(name, None)
+                    inst.view.expire()
+                    self._send_status(
+                        conn, f"dataflow {name!r} failed: {e!r}"
+                    )
+                    worked = True
+            worked |= self._serve_peeks(conn)
+            worked |= self._report_frontiers(conn)
+            if not worked:
+                _time.sleep(0.002)  # park
+
+    def _build(self, desc: DataflowDescription) -> _Installed:
+        """Build (or rebuild) a dataflow. Hydration can race with an
+        active-active sibling writing the same sink (SinkConflict) or
+        with its compaction moving the as_of (ValueError): both are
+        transient — retry against the fresh durable state."""
+        last: Exception | None = None
+        for _ in range(5):
+            try:
+                return _Installed(
+                    desc,
+                    MaintainedView(
+                        self.client,
+                        Dataflow(desc.expr, name=desc.name),
+                        desc.source_imports,
+                        desc.sink_shard,
+                    ),
+                )
+            except (SinkConflict, Fenced, ValueError) as e:
+                # Fenced: an active-active sibling re-registered the sink
+                # writer mid-hydration (epoch ping-pong) — rebuild picks
+                # up the durable state it wrote.
+                last = e
+                _time.sleep(0.01)
+        raise last
+
+    def _send_status(self, conn, error: str) -> None:
+        if conn is None:
+            return
+        try:
+            ctp.send_msg(
+                conn,
+                {
+                    "kind": "Status",
+                    "error": error,
+                    "replica_id": self.replica_id,
+                },
+            )
+        except (ctp.TransportError, OSError):
+            pass
+
+    def _handle_command(self, conn, cmd: dict) -> None:
+        kind = cmd["kind"]
+        if kind == "CreateDataflow":
+            desc: DataflowDescription = cmd["desc"]
+            existing = self.dataflows.get(desc.name)
+            if (
+                existing is not None
+                and existing.fingerprint == desc.fingerprint()
+            ):
+                existing.reported_upper = -1  # re-report frontier
+                return  # reconciliation: unchanged, keep running
+            if existing is not None:
+                existing.view.expire()  # replaced: release read holds
+            try:
+                self.dataflows[desc.name] = self._build(desc)
+            except Exception as e:
+                # A bad plan must not kill the replica: report and skip
+                # (scoped halt!; the reference would crash-loop the whole
+                # process, we keep sibling dataflows alive).
+                self._send_status(
+                    conn, f"CreateDataflow {desc.name!r} failed: {e!r}"
+                )
+        elif kind == "DropDataflow":
+            inst = self.dataflows.pop(cmd["name"], None)
+            if inst is not None:
+                inst.view.expire()
+        elif kind == "Peek":
+            self.pending_peeks.append(cmd)
+        elif kind == "CancelPeek":
+            self.pending_peeks = [
+                p for p in self.pending_peeks
+                if p["peek_id"] != cmd["peek_id"]
+            ]
+        elif kind == "AllowCompaction":
+            inst = self.dataflows.get(cmd["dataflow"])
+            if inst is not None:
+                for s in inst.view.sources.values():
+                    s.reader.downgrade_since(cmd["since"])
+                    s.reader.machine.maybe_compact()
+        elif kind == "UpdateConfiguration":
+            # Command-stream ordering makes every worker flip the flags
+            # at the same point (compute_state.rs:46-59 analog).
+            self.config.update(cmd["params"])
+
+    def _serve_peeks(self, conn) -> bool:
+        served = False
+        keep = []
+        for p in self.pending_peeks:
+            inst = self.dataflows.get(p["dataflow"])
+            if inst is None:
+                ctp.send_msg(
+                    conn,
+                    {
+                        "kind": "PeekResponse",
+                        "peek_id": p["peek_id"],
+                        "error": f"no such dataflow {p['dataflow']}",
+                        "replica_id": self.replica_id,
+                    },
+                )
+                served = True
+                continue
+            as_of = p["as_of"]
+            if as_of is not None and inst.view.upper <= as_of:
+                keep.append(p)  # not yet complete at as_of
+                continue
+            rows = inst.view.peek()
+            ctp.send_msg(
+                conn,
+                {
+                    "kind": "PeekResponse",
+                    "peek_id": p["peek_id"],
+                    "rows": rows,
+                    "served_at": inst.view.upper - 1,
+                    "replica_id": self.replica_id,
+                },
+            )
+            served = True
+        self.pending_peeks = keep
+        return served
+
+    def _report_frontiers(self, conn) -> bool:
+        changed = {}
+        for name, inst in self.dataflows.items():
+            upper = inst.view.upper
+            if upper != inst.reported_upper:
+                changed[name] = upper
+                inst.reported_upper = upper
+        if changed:
+            ctp.send_msg(
+                conn,
+                {
+                    "kind": "Frontiers",
+                    "uppers": changed,
+                    "replica_id": self.replica_id,
+                },
+            )
+            return True
+        return False
+
+
+def serve_forever(
+    port: int,
+    location: PersistLocation,
+    replica_id: str = "r0",
+    ready_event: threading.Event | None = None,
+) -> None:
+    worker = ReplicaWorker(location=location, replica_id=replica_id)
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind(("127.0.0.1", port))
+    sock.listen(4)
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        worker.serve(sock)
+    finally:
+        sock.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="materialize_tpu replica")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--blob", required=True)
+    ap.add_argument("--consensus", required=True)
+    ap.add_argument("--replica-id", default="r0")
+    args = ap.parse_args()
+    print(f"replica {args.replica_id} listening on {args.port}", flush=True)
+    serve_forever(
+        args.port,
+        PersistLocation(args.blob, args.consensus),
+        args.replica_id,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -13,12 +13,20 @@ output shard's upper.
 
 from __future__ import annotations
 
+import time as _time
+
 import numpy as np
 
 from ...render.dataflow import Dataflow
 from ...repr.batch import Batch, capacity_tier
 from ...repr.schema import Schema
 from .client import PersistClient, ReadHandle, WriteHandle
+from .machine import Fenced, UpperMismatch
+
+
+class SinkConflict(RuntimeError):
+    """The durable sink diverged from this replica's chunking (hydration
+    race): the view must be rebuilt from the durable shard."""
 
 
 def updates_to_batch(
@@ -87,17 +95,21 @@ class ShardSource:
 
 class MaintainedView:
     """An installed dataflow maintained between shards: sources -> step ->
-    output shard. One shard per source name; the output shard's upper is
-    the view's write frontier (sink/materialized_view_v2.rs analog —
-    self-correcting via compare-and-append: on restart a partially
-    written step is retried exactly because the upper didn't advance)."""
+    optional output shard. One shard per source name; with a sink, the
+    output shard's upper is the view's write frontier
+    (sink/materialized_view_v2.rs analog — self-correcting via
+    compare-and-append: on restart a partially written step is retried
+    exactly because the upper didn't advance). Without a sink this is an
+    INDEX: the output arrangement lives on device, peekable, and the
+    frontier is in-memory (restart = full rehydration from inputs, the
+    reference's index model)."""
 
     def __init__(
         self,
         client: PersistClient,
         dataflow: Dataflow,
         source_shards: dict[str, tuple[str, Schema]],
-        output_shard: str,
+        output_shard: str | None,
     ):
         self.client = client
         self.df = dataflow
@@ -105,24 +117,71 @@ class MaintainedView:
             name: ShardSource(client.open_reader(shard), schema)
             for name, (shard, schema) in source_shards.items()
         }
-        self.writer: WriteHandle = client.open_writer(
-            output_shard, dataflow.out_schema
+        self.writer: WriteHandle | None = (
+            client.open_writer(output_shard, dataflow.out_schema)
+            if output_shard is not None
+            else None
         )
-        self.hydrate()
+        # The replica-LOCAL processed frontier. Never conflated with the
+        # durable sink upper: an active-active sibling may advance the
+        # shard ahead of this replica, and stepping from the shard upper
+        # would skip inputs locally (stale peeks) and double-count deltas
+        # in the sink. Appends behind the durable upper skip benignly
+        # (identical content by determinism + 1-timestamp chunks).
+        self._upper = 0
+        try:
+            self.hydrate()
+        except BaseException:
+            self.expire()  # release reader holds of a failed build
+            raise
+
+    @property
+    def upper(self) -> int:
+        """This replica's processed frontier: the local output reflects
+        input times < upper."""
+        return self._upper
+
+    def expire(self) -> None:
+        """Release this view's shard read holds (must be called when the
+        view is dropped or replaced, or the holds pin compaction forever)."""
+        for s in self.sources.values():
+            try:
+                s.reader.expire()
+            except Exception:
+                pass
 
     # -- rehydration -------------------------------------------------------
     def hydrate(self) -> None:
-        """Bring the dataflow to the output shard's upper: snapshot every
-        input at as_of = upper-1 (or the inputs' max since if the output
-        is empty), run one step, append the initial output if needed."""
-        out_upper = self.writer.upper
+        """Bring the dataflow to the output's upper.
+
+        Fresh install: as-of selection picks the LATEST readable time,
+        ``max(max input since, min input upper - 1)`` (collapse as much
+        history into one snapshot step as possible —
+        compute-client/src/as_of_selection.rs); if the inputs are all
+        empty and uncompacted the dataflow simply starts at 0 and replays
+        updates as they arrive. Resume: snapshot inputs at the durable
+        upper-1 and rebuild arrangements without re-appending."""
+        out_upper = (
+            self.writer.machine.reload().upper
+            if self.writer is not None
+            else 0
+        )
         if out_upper == 0:
-            as_of = max(
-                s.reader.machine.reload().since
-                for s in self.sources.values()
-            )
+            sts = [
+                s.reader.machine.reload() for s in self.sources.values()
+            ]
+            max_since = max((st.since for st in sts), default=0)
+            min_upper = min((st.upper for st in sts), default=0)
+            as_of = max(max_since, min_upper - 1)
+            if as_of <= 0 and max_since == 0:
+                # Nothing (or only t=0) ingested and no compaction:
+                # replay from scratch, no snapshot step needed.
+                for s in self.sources.values():
+                    s.resume_at(0)
+                self._upper = 0
+                return
             # Inputs must be readable at as_of; wait for uppers to pass
-            # (as-of selection, compute-client/src/as_of_selection.rs).
+            # (can lag when one input is compacted ahead of another).
             for s in self.sources.values():
                 if s.reader.wait_for_upper(as_of, timeout=30.0) is None:
                     raise TimeoutError(
@@ -137,6 +196,7 @@ class MaintainedView:
             self.df.step(inputs)
             out = self._output_snapshot_delta()
             self._append(out, 0, as_of + 1, as_of)
+            self._upper = as_of + 1
         else:
             as_of = out_upper - 1
             inputs = {}
@@ -146,20 +206,62 @@ class MaintainedView:
             self.df.time = as_of
             self.df.step(inputs)  # rebuild arrangements; output delta
             # already durable — do NOT append.
+            self._upper = out_upper
 
     def _output_snapshot_delta(self) -> Batch:
         # After hydration the output arrangement IS the initial delta.
         return self.df.output.batch
 
     def _append(self, batch: Batch, lower: int, upper: int, t: int) -> None:
+        """Append the step's output delta. In active-active replication
+        every replica computes every step deterministically and races the
+        compare-and-append; losing the race (upper already advanced, or
+        fenced by the other replica's writer) means the content is
+        already durable — identical by determinism — so losing IS
+        success (the reference's multi-replica persist-sink model,
+        sink/materialized_view_v2.rs)."""
+        if self.writer is None:
+            return
         cols = batch.to_columns()
-        data_cols, _time, diff = cols[:-2], cols[-2], cols[-1]
+        data_cols, diff = cols[:-2], cols[-1]
         n = len(diff)
         nulls = [
             None if nl is None else np.asarray(nl)[:n] for nl in batch.nulls
         ]
-        self.writer.compare_and_append(
-            data_cols, nulls, np.full(n, t, np.uint64), diff, lower, upper
+        for attempt in range(5):
+            try:
+                self.writer.compare_and_append(
+                    data_cols, nulls, np.full(n, t, np.uint64), diff,
+                    lower, upper,
+                )
+                return
+            except UpperMismatch as e:
+                if e.actual >= upper:
+                    # Another replica already wrote these times. Safe to
+                    # skip: steady-state chunks are one timestamp and
+                    # deltas are deterministic, so the durable content
+                    # for [lower, upper) is identical to ours; our LOCAL
+                    # frontier still advances only to `upper`.
+                    return
+                # Another replica durably wrote a SHORTER chunk (a
+                # hydration race); our local state has advanced past it
+                # and cannot produce the split — the owner must rebuild
+                # from the durable shard.
+                raise SinkConflict(
+                    f"sink chunk [{lower},{upper}) conflicts with "
+                    f"durable upper {e.actual}"
+                )
+            except Fenced:
+                if self.writer.machine.reload().upper >= upper:
+                    return  # the fencing writer covered it
+                # Re-register and retry; jittered sleep breaks epoch
+                # ping-pong between active-active siblings.
+                self.writer.epoch = self.writer.machine.register_writer()
+                _time.sleep(0.001 * (attempt + 1) * (1 + (id(self) % 7)))
+        # The delta is NOT lost on this exit: the rebuild path re-derives
+        # state from the durable shard and the sources.
+        raise SinkConflict(
+            f"sink append [{lower},{upper}) kept losing writer fencing"
         )
 
     # -- steady state ------------------------------------------------------
@@ -168,13 +270,19 @@ class MaintainedView:
         (min over input uppers beyond our own): the micro-batch analog of
         frontier-joined progress. Returns False if the inputs did not
         advance within the timeout."""
-        lower = self.writer.upper
+        lower = self.upper
         target = None
         for s in self.sources.values():
             upper = s.reader.wait_for_upper(lower, timeout)  # > lower
             if upper is None:
                 return False
             target = upper if target is None else min(target, upper)
+        # One timestamp per steady-state step: chunk boundaries are then
+        # DETERMINISTIC across active-active replicas, so racing sink
+        # appends are byte-identical and losing a race is always safe.
+        # (Backlogs are collapsed by hydrate's snapshot, not here; a
+        # correction-buffer sink, correction_v2.rs, would lift this.)
+        target = min(target, lower + 1)
         polled = {
             name: s.fetch_to(target) for name, s in self.sources.items()
         }
@@ -182,11 +290,12 @@ class MaintainedView:
         self.df.time = t
         out = self.df.step(polled)
         self._append(out, lower, target, t)
+        self._upper = target
         return True
 
     def run_until(self, frontier: int, timeout: float = 30.0) -> None:
         """Advance until the output upper reaches ``frontier``."""
-        while self.writer.upper < frontier:
+        while self.upper < frontier:
             if not self.step(timeout):
                 raise TimeoutError(
                     f"sources stalled below frontier {frontier}"
