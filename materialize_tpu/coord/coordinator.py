@@ -1,0 +1,559 @@
+"""The Coordinator: SQL sequencing over catalog + controller + oracle.
+
+Analog of the reference's ``Coordinator`` (adapter/src/coord.rs:1989,
+``serve():4696``): owns the durable catalog, the timestamp oracle, the
+compute controller, and the storage runtime (generator sources); turns
+SQL statements into catalog transactions + dataflow installations +
+peeks. DDL is durably recorded (as SQL text, replayed on boot — the
+expression-cache-less version of catalog/src/durable.rs) before taking
+effect, so a restarted coordinator reconstructs everything
+(``bootstrap``, coord.rs).
+
+Single-threaded sequencing: ``execute`` takes one statement at a time
+under a lock, exactly the single-coordinator-loop discipline of the
+reference (simple, and all the heavy lifting is async underneath).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..expr import relation as mir
+from ..repr.schema import GLOBAL_DICT, Column, ColumnType, Schema
+from ..sql.catalog import Catalog as SqlCatalog
+from ..sql.catalog import CatalogItem
+from ..sql.hir import PlanError
+from ..sql.plan import (
+    CreateIndexPlan,
+    CreateSourcePlan,
+    CreateViewPlan,
+    DropPlan,
+    ExplainPlan,
+    SelectPlan,
+    ShowPlan,
+    SubscribePlan,
+    plan_statement,
+)
+from ..storage.persist import PersistClient
+from ..transform.optimizer import optimize
+from .controller import ComputeController
+from .protocol import DataflowDescription
+from .sources import GeneratorSource
+
+CATALOG_SHARD = "mz_catalog"
+CATALOG_SCHEMA = Schema([Column("item", ColumnType.STRING)])
+
+
+@dataclass
+class ExecuteResult:
+    """What a statement returns to the session (ExecuteResponse analog,
+    adapter/src/command.rs)."""
+
+    kind: str  # "rows" | "text" | "ok"
+    rows: list = field(default_factory=list)
+    columns: tuple = ()
+    text: str = ""
+
+
+class Coordinator:
+    def __init__(
+        self,
+        persist: PersistClient,
+        tick_interval: float | None = None,
+    ):
+        self.persist = persist
+        self.catalog = SqlCatalog()
+        self.controller = ComputeController()
+        # The timestamp oracle (oracle.py) joins when table writes land:
+        # generator sources carry their own per-source tick timelines, so
+        # reads select min(upper)-1 per shard set instead (the oracle is
+        # for the shared epoch-ms timeline of user tables).
+        self.sources: dict[str, GeneratorSource] = {}
+        self.tick_interval = tick_interval
+        # name -> installed dataflow name serving peeks for it
+        self.peekable: dict[str, str] = {}
+        # dataflow name -> upstream SOURCE shards (timestamp selection
+        # reads at the sources' time, then waits for the dataflow).
+        self._df_upstream: dict[str, list] = {}
+        # durable catalog bookkeeping
+        self._cat_writer = self.persist.open_writer(
+            CATALOG_SHARD, CATALOG_SCHEMA
+        )
+        self._item_seq = 0
+        self._transient_seq = 0
+        self._lock = threading.RLock()
+        self._bootstrap()
+
+    # -- replicas -----------------------------------------------------------
+    def add_replica(self, name: str, addr) -> None:
+        self.controller.add_replica(name, addr)
+
+    # -- durable catalog ----------------------------------------------------
+    def _catalog_append(self, record: dict, diff: int) -> None:
+        code = GLOBAL_DICT.encode(json.dumps(record, sort_keys=True))
+        t = self._cat_writer.upper
+        self._cat_writer.compare_and_append(
+            [np.array([code], np.int32)],
+            [None],
+            np.array([t], np.uint64),
+            np.array([diff], np.int64),
+            t,
+            t + 1,
+        )
+
+    def _catalog_live_records(self) -> list[dict]:
+        st = self._cat_writer.machine.reload()
+        if st.upper == 0:
+            return []
+        reader = self.persist.open_reader(CATALOG_SHARD, "coord-boot")
+        try:
+            _sch, cols, _nulls, _time, diff = reader.snapshot(st.upper - 1)
+        finally:
+            reader.expire()
+        acc: dict[str, int] = {}
+        for code, d in zip(cols[0], diff):
+            s = GLOBAL_DICT.decode(int(code))
+            acc[s] = acc.get(s, 0) + int(d)
+        records = [json.loads(s) for s, d in acc.items() if d > 0]
+        records.sort(key=lambda r: r["id"])
+        return records
+
+    def _bootstrap(self) -> None:
+        """Replay the durable catalog: re-plan every recorded DDL in id
+        order (bootstrap, adapter/src/coord.rs; dataflow as-ofs are
+        re-selected by the replicas on CreateDataflow)."""
+        for rec in self._catalog_live_records():
+            self._item_seq = max(self._item_seq, rec["id"])
+            self._sequence(
+                plan_statement(rec["sql"], self.catalog),
+                sql=rec["sql"],
+                replay=True,
+                record=rec,
+            )
+
+    # -- statement execution -------------------------------------------------
+    def execute(self, sql: str) -> ExecuteResult:
+        with self._lock:
+            plan = plan_statement(sql, self.catalog)
+            return self._sequence(plan, sql=sql)
+
+    def _sequence(
+        self, plan, sql: str, replay: bool = False, record: dict | None = None
+    ) -> ExecuteResult:
+        if isinstance(plan, CreateSourcePlan):
+            return self._sequence_create_source(plan, sql, replay, record)
+        if isinstance(plan, CreateViewPlan):
+            return self._sequence_create_view(plan, sql, replay, record)
+        if isinstance(plan, CreateIndexPlan):
+            return self._sequence_create_index(plan, sql, replay, record)
+        if isinstance(plan, SelectPlan):
+            return self._sequence_peek(plan)
+        if isinstance(plan, DropPlan):
+            return self._sequence_drop(plan)
+        if isinstance(plan, ExplainPlan):
+            return ExecuteResult(
+                "text", text=plan.text, columns=("explain",)
+            )
+        if isinstance(plan, ShowPlan):
+            rows = sorted(
+                (it.name, it.kind)
+                for it in self.catalog.items.values()
+                if plan.kind in ("objects", it.kind)
+            )
+            return ExecuteResult("rows", rows=rows, columns=("name", "kind"))
+        raise PlanError(f"cannot sequence {type(plan).__name__}")
+
+    # -- DDL -----------------------------------------------------------------
+    def _record_ddl(self, sql: str, extra: dict | None = None) -> dict:
+        self._item_seq += 1
+        rec = {"id": self._item_seq, "sql": sql}
+        if extra:
+            rec.update(extra)
+        self._catalog_append(rec, +1)
+        return rec
+
+    def _sequence_create_source(
+        self, plan: CreateSourcePlan, sql, replay, record
+    ) -> ExecuteResult:
+        if not replay:
+            # Validate every name this source will claim BEFORE the
+            # durable record: subsource collisions too.
+            from .sources import GENERATORS
+
+            if plan.generator not in GENERATORS:
+                raise PlanError(
+                    f"unknown load generator {plan.generator!r}"
+                )
+            self._check_name_free(plan.name)
+        if record is None:
+            record = self._record_ddl(sql, {"name": plan.name})
+        shard_prefix = f"u{record['id']}"
+        src = GeneratorSource(
+            self.persist,
+            plan.name,
+            plan.generator,
+            plan.options,
+            shard_prefix,
+            tick_interval=self.tick_interval,
+        )
+        self.sources[plan.name] = src
+        for sub, schema in src.adapter.subsources.items():
+            self.catalog.create(
+                CatalogItem(
+                    name=sub,
+                    kind="source",
+                    schema=schema,
+                    definition={
+                        "shard": src.shards[sub],
+                        "source": plan.name,
+                    },
+                ),
+                or_replace=True,
+            )
+        self.catalog.create(
+            CatalogItem(
+                name=plan.name,
+                kind="source",
+                schema=Schema([]),
+                definition={"generator": plan.generator},
+            ),
+            or_replace=True,
+        )
+        src.start()
+        return ExecuteResult("ok")
+
+    def _inline_views(self, expr: mir.RelationExpr) -> mir.RelationExpr:
+        """Replace Get(view) with the view's definition so rendered
+        dataflows bottom out at sources (view inlining; the reference
+        does this during global optimization). Operators are positional,
+        so the view's internal column names need no reconciliation."""
+
+        def walk(e):
+            if isinstance(e, mir.Get):
+                it = self.catalog.items.get(e.name)
+                if it is not None and it.kind == "view":
+                    return walk(it.definition)
+                return e
+            return _rewrite_children(e, walk)
+
+        return walk(expr)
+
+    def _source_imports(self, expr: mir.RelationExpr) -> dict:
+        """Every Get leaf must be a source subsource or a maintained MV
+        shard: name -> (shard, schema)."""
+        imports: dict = {}
+
+        def walk(e):
+            if isinstance(e, mir.Get):
+                it = self.catalog.items.get(e.name)
+                if it is None:
+                    raise PlanError(f"unknown relation {e.name!r}")
+                if it.kind == "source":
+                    imports[e.name] = (it.definition["shard"], it.schema)
+                elif it.kind == "materialized-view":
+                    imports[e.name] = (it.definition["shard"], it.schema)
+                else:
+                    raise PlanError(
+                        f"{e.name!r} ({it.kind}) is not directly "
+                        "readable; create an index or materialize it"
+                    )
+            for c in e.children():
+                walk(c)
+
+        walk(expr)
+        return imports
+
+    def _check_name_free(self, name: str, or_replace: bool = False) -> None:
+        """Validate BEFORE durably recording DDL: a poison record that
+        fails catalog.create on replay would brick every future boot."""
+        if name in self.catalog.items and not or_replace:
+            raise PlanError(f"catalog item {name!r} already exists")
+
+    def _sequence_create_view(
+        self, plan: CreateViewPlan, sql, replay, record=None
+    ) -> ExecuteResult:
+        schema = plan.expr.schema().rename(plan.column_names)
+        expr = plan.expr
+        if plan.materialized:
+            self._check_name_free(plan.name, plan.or_replace)
+            inlined = optimize(self._inline_views(expr))
+            imports = self._source_imports(inlined)
+            if record is None:
+                record = self._record_ddl(sql, {"name": plan.name})
+            # Shard named by the unique record id: DROP + re-CREATE of
+            # the same name must NOT resume from the old MV's data.
+            shard = f"u{record['id']}_mv"
+            self._register_dataflow(
+                DataflowDescription(
+                    name=plan.name,
+                    expr=inlined,
+                    source_imports=imports,
+                    sink_shard=shard,
+                )
+            )
+            self.catalog.create(
+                CatalogItem(
+                    name=plan.name,
+                    kind="materialized-view",
+                    schema=schema,
+                    definition={"shard": shard, "expr": expr},
+                    column_names=plan.column_names,
+                ),
+                or_replace=plan.or_replace,
+            )
+            self.peekable[plan.name] = plan.name
+        else:
+            self._check_name_free(plan.name, plan.or_replace)
+            if not replay:
+                self._record_ddl(sql, {"name": plan.name})
+            self.catalog.create(
+                CatalogItem(
+                    name=plan.name,
+                    kind="view",
+                    schema=schema,
+                    definition=expr,
+                    column_names=plan.column_names,
+                ),
+                or_replace=plan.or_replace,
+            )
+        return ExecuteResult("ok")
+
+    def _sequence_create_index(
+        self, plan: CreateIndexPlan, sql, replay, record=None
+    ) -> ExecuteResult:
+        it = self.catalog.items.get(plan.on)
+        if it is None:
+            raise PlanError(f"unknown relation {plan.on!r}")
+        self._check_name_free(plan.name)
+        if plan.on in self.peekable:
+            # MVs (and already-indexed views) are already peekable; the
+            # reference would build another arrangement — we reuse, but
+            # the index still gets a catalog item (visible, droppable).
+            if not replay:
+                self._record_ddl(sql, {"name": plan.name})
+            self.catalog.create(
+                CatalogItem(
+                    name=plan.name,
+                    kind="index",
+                    schema=it.schema,
+                    definition={"on": plan.on, "reused": True},
+                )
+            )
+            return ExecuteResult("ok")
+        if it.kind == "view":
+            expr = optimize(self._inline_views(it.definition))
+        elif it.kind == "source":
+            expr = mir.Get(plan.on, it.schema)
+        else:
+            raise PlanError(f"cannot index {it.kind} {plan.on!r}")
+        imports = self._source_imports(expr)
+        if not replay:
+            self._record_ddl(sql, {"name": plan.name})
+        self._register_dataflow(
+            DataflowDescription(
+                name=plan.name,
+                expr=expr,
+                source_imports=imports,
+                sink_shard=None,
+            )
+        )
+        self.catalog.create(
+            CatalogItem(
+                name=plan.name,
+                kind="index",
+                schema=it.schema,
+                definition={"on": plan.on},
+            )
+        )
+        self.peekable[plan.on] = plan.name
+        return ExecuteResult("ok")
+
+    def _dependents(self, names: set) -> list[str]:
+        """Live catalog items that reference any of `names` (Get leaves
+        of view/MV definitions, index targets)."""
+        out = []
+        for it in self.catalog.items.values():
+            if it.kind == "index":
+                if it.definition["on"] in names:
+                    out.append(it.name)
+            elif it.kind in ("view", "materialized-view"):
+                expr = (
+                    it.definition
+                    if it.kind == "view"
+                    else it.definition["expr"]
+                )
+                hit = []
+
+                def walk(e):
+                    if isinstance(e, mir.Get) and e.name in names:
+                        hit.append(e.name)
+                    for c in e.children():
+                        walk(c)
+
+                walk(expr)
+                if hit:
+                    out.append(it.name)
+        return out
+
+    _DROP_KINDS = {
+        "view": {"view", "materialized-view"},
+        "source": {"source"},
+        "index": {"index"},
+        "object": {"view", "materialized-view", "source", "index"},
+    }
+
+    def _sequence_drop(self, plan: DropPlan) -> ExecuteResult:
+        name = plan.name
+        it = self.catalog.items.get(name)
+        if it is None:
+            if plan.if_exists:
+                return ExecuteResult("ok")
+            raise PlanError(f"unknown catalog item {name!r}")
+        allowed = self._DROP_KINDS.get(plan.kind.lower())
+        if allowed is not None and it.kind not in allowed:
+            raise PlanError(
+                f"{name!r} is a {it.kind}, not a {plan.kind}"
+            )
+        # Dependency check: a drop that leaves a dangling reference
+        # would make the durable catalog unreplayable (bricked boot).
+        doomed = {name}
+        if it.kind == "source":
+            src = self.sources.get(name)
+            if src is not None:
+                doomed.update(src.adapter.subsources)
+        deps = [d for d in self._dependents(doomed) if d not in doomed]
+        if deps:
+            raise PlanError(
+                f"cannot drop {name!r}: still depended on by {deps}"
+            )
+        # Remove the durable record (retract by replayed-sql identity).
+        for rec in self._catalog_live_records():
+            if rec.get("name") == name:
+                self._catalog_append(rec, -1)
+        if it.kind == "materialized-view":
+            self.controller.drop_dataflow(name)
+            self.peekable.pop(name, None)
+            self._df_upstream.pop(name, None)
+        elif it.kind == "index":
+            self.controller.drop_dataflow(name)
+            self._df_upstream.pop(name, None)
+            on = it.definition["on"]
+            if self.peekable.get(on) == name:
+                del self.peekable[on]
+        elif it.kind == "source":
+            src = self.sources.pop(name, None)
+            if src is not None:
+                src.stop()
+                for sub in src.adapter.subsources:
+                    self.catalog.drop(sub, if_exists=True)
+        self.catalog.drop(name)
+        return ExecuteResult("ok")
+
+    # -- peeks ---------------------------------------------------------------
+    def _sequence_peek(self, plan: SelectPlan) -> ExecuteResult:
+        expr = optimize(self._inline_views(plan.expr))
+        # Fast path (peek.rs fast-path detection): a bare Get of a
+        # peekable (indexed / materialized) relation. Timestamp
+        # selection (coord/timestamp_selection.rs): read at the latest
+        # complete time of the UPSTREAM SOURCES, waiting for the
+        # dataflow's frontier to pass it (freshness: the read is
+        # linearizable w.r.t. ingested data, not merely whatever the
+        # dataflow happens to have processed).
+        if isinstance(expr, mir.Get) and expr.name in self.peekable:
+            df = self.peekable[expr.name]
+            as_of = self._select_timestamp_shards(
+                self._df_upstream.get(df, [])
+            )
+            rows, _ = self.controller.peek(df, as_of=as_of)
+            return ExecuteResult(
+                "rows", rows=_finish(rows), columns=plan.column_names
+            )
+        # Slow path: transient dataflow, peek, drop (life-of-a-query
+        # slow path).
+        imports = self._source_imports(expr)
+        self._transient_seq += 1
+        name = f"t{self._transient_seq}"
+        self._register_dataflow(
+            DataflowDescription(
+                name=name,
+                expr=expr,
+                source_imports=imports,
+                sink_shard=None,
+            )
+        )
+        try:
+            as_of = self._select_timestamp_shards(
+                self._df_upstream.get(name, [])
+            )
+            rows, _ = self.controller.peek(name, as_of=as_of)
+        finally:
+            self.controller.drop_dataflow(name)
+            self._df_upstream.pop(name, None)
+        return ExecuteResult(
+            "rows", rows=_finish(rows), columns=plan.column_names
+        )
+
+    def _register_dataflow(self, desc: DataflowDescription) -> None:
+        self._df_upstream[desc.name] = [
+            sh for sh, _ in desc.source_imports.values()
+        ]
+        self.controller.create_dataflow(desc)
+
+    def _select_timestamp_shards(self, shards: list[str]) -> int:
+        """Timestamp selection (coord/timestamp_selection.rs): the latest
+        complete time across the inputs = min(upper) - 1."""
+        uppers = [
+            self.persist.machine(sh).reload().upper for sh in shards
+        ]
+        if not uppers:
+            return 0
+        return max(min(uppers) - 1, 0)
+
+    def shutdown(self) -> None:
+        for src in self.sources.values():
+            src.stop()
+        self.controller.shutdown()
+
+
+def _finish(rows: list) -> list:
+    """Collapse (cols..., time, diff) into SELECT result rows with
+    multiplicities expanded (RowSetFinishing application, coord/peek.rs)."""
+    acc: dict = {}
+    for r in rows:
+        acc[r[:-2]] = acc.get(r[:-2], 0) + r[-1]
+    out = []
+    for vals, mult in sorted(acc.items()):
+        if mult < 0:
+            raise RuntimeError(
+                f"negative multiplicity {mult} for row {vals} "
+                "(non-monotonic input to a raw SELECT?)"
+            )
+        out.extend([vals] * mult)
+    return out
+
+
+def _rewrite_children(e: mir.RelationExpr, fn) -> mir.RelationExpr:
+    """Rebuild `e` with `fn` applied to every RelationExpr child
+    (generic MIR visitor; the nodes are frozen dataclasses)."""
+    import dataclasses
+
+    kwargs = {}
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, mir.RelationExpr):
+            nv = fn(v)
+            if nv is not v:
+                kwargs[f.name] = nv
+        elif (
+            isinstance(v, tuple)
+            and v
+            and all(isinstance(x, mir.RelationExpr) for x in v)
+        ):
+            nv = tuple(fn(x) for x in v)
+            if any(a is not b for a, b in zip(nv, v)):
+                kwargs[f.name] = nv
+    return dataclasses.replace(e, **kwargs) if kwargs else e

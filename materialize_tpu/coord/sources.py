@@ -1,0 +1,295 @@
+"""Storage runtime: load-generator sources feeding shards.
+
+Analog of the reference's source pipeline (``storage/src/source/
+source_reader_pipeline.rs:165`` + the load generators in ``storage/src/
+source/generator/{tpch,auction,counter}.rs``): a source is a set of
+*subsources* (one per relation, e.g. TPCH's lineitem/orders/...), each
+bound to its own shard; a runner thread appends one update chunk per
+tick, advancing every subsource's upper in lockstep so downstream
+frontiers progress even when a tick touches only some relations.
+
+Restart/resume is deterministic reclocking: the tick counter IS the
+virtual timestamp, so a restarted runner continues at ``tick = upper``
+and regenerates byte-identical churn (generators are seeded per tick) —
+the remap-collection idea of ``source/reclock.rs`` collapsed onto the
+identity binding.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+
+import numpy as np
+
+from ..repr.batch import Batch
+from ..repr.schema import Column, ColumnType, Schema
+from ..storage.generator.auction import (
+    ACCOUNTS_SCHEMA,
+    AUCTIONS_SCHEMA,
+    BIDS_SCHEMA,
+    ORGANIZATIONS_SCHEMA,
+    USERS_SCHEMA,
+    AuctionGenerator,
+)
+from ..storage.generator.tpch import (
+    CUSTOMER_SCHEMA,
+    LINEITEM_SCHEMA,
+    NATION_SCHEMA,
+    ORDERS_SCHEMA,
+    PART_SCHEMA,
+    PARTSUPP_SCHEMA,
+    REGION_SCHEMA,
+    SUPPLIER_SCHEMA,
+    TpchGenerator,
+)
+from ..storage.persist import PersistClient, WriteHandle
+
+COUNTER_SCHEMA = Schema([Column("counter", ColumnType.INT64)])
+
+
+class GeneratorAdapter:
+    """Uniform generator interface: subsource schemas, a snapshot (t=0),
+    and per-tick update batches."""
+
+    subsources: dict
+
+    def snapshot(self) -> dict:
+        return {}
+
+    def tick(self, tick: int, time: int) -> dict:
+        return {}
+
+
+class TpchAdapter(GeneratorAdapter):
+    def __init__(self, options: dict):
+        sf = float(options.get("scale_factor", 0.01))
+        seed = int(options.get("seed", 1))
+        self.churn_orders = int(options.get("churn_orders", 16))
+        self.gen = TpchGenerator(sf=sf, seed=seed)
+        self.subsources = {
+            "lineitem": LINEITEM_SCHEMA,
+            "orders": ORDERS_SCHEMA,
+            "supplier": SUPPLIER_SCHEMA,
+            "part": PART_SCHEMA,
+            "partsupp": PARTSUPP_SCHEMA,
+            "customer": CUSTOMER_SCHEMA,
+            "nation": NATION_SCHEMA,
+            "region": REGION_SCHEMA,
+        }
+
+    def snapshot(self) -> dict:
+        out = {
+            name: self.gen.table_batch(name, time=0)
+            for name in (
+                "supplier", "part", "partsupp", "customer", "nation",
+                "region",
+            )
+        }
+        li = list(self.gen.snapshot_lineitem_batches(time=0))
+        out["lineitem"] = li
+        keys = np.arange(1, self.gen.n_orders + 1)
+        ocols = self.gen.orders_rows(keys)
+        out["orders"] = Batch.from_numpy(
+            ORDERS_SCHEMA,
+            ocols,
+            np.zeros(len(ocols[0]), np.uint64),
+            np.ones(len(ocols[0]), np.int64),
+        )
+        return out
+
+    def tick(self, tick: int, time: int) -> dict:
+        return {
+            "lineitem": self.gen.churn_lineitem_batch(
+                min(self.churn_orders, self.gen.n_orders), tick, time
+            )
+        }
+
+
+class AuctionAdapter(GeneratorAdapter):
+    def __init__(self, options: dict):
+        self.gen = AuctionGenerator(
+            n_users=int(options.get("users", 128)),
+            auctions_per_tick=int(options.get("auctions_per_tick", 4)),
+            bids_per_auction=int(options.get("bids_per_auction", 4)),
+            seed=int(options.get("seed", 1)),
+            retract_after=options.get("retract_after"),
+        )
+        self.subsources = {
+            "organizations": ORGANIZATIONS_SCHEMA,
+            "users": USERS_SCHEMA,
+            "accounts": ACCOUNTS_SCHEMA,
+            "auctions": AUCTIONS_SCHEMA,
+            "bids": BIDS_SCHEMA,
+        }
+
+    def snapshot(self) -> dict:
+        return self.gen.snapshot(time=0)
+
+    def tick(self, tick: int, time: int) -> dict:
+        return self.gen.tick(tick, time)
+
+
+class CounterAdapter(GeneratorAdapter):
+    """The reference's COUNTER generator: appends one incrementing value
+    per tick; with max_cardinality the oldest is retracted."""
+
+    def __init__(self, options: dict):
+        self.max_cardinality = options.get("max_cardinality")
+        self.subsources = {"counter": COUNTER_SCHEMA}
+
+    def snapshot(self) -> dict:
+        return {
+            "counter": Batch.from_numpy(
+                COUNTER_SCHEMA,
+                [np.array([0], np.int64)],
+                np.zeros(1, np.uint64),
+                np.ones(1, np.int64),
+            )
+        }
+
+    def tick(self, tick: int, time: int) -> dict:
+        vals = [tick]
+        diffs = [1]
+        if (
+            self.max_cardinality is not None
+            and tick >= int(self.max_cardinality)
+        ):
+            vals.append(tick - int(self.max_cardinality))
+            diffs.append(-1)
+        return {
+            "counter": Batch.from_numpy(
+                COUNTER_SCHEMA,
+                [np.array(vals, np.int64)],
+                np.full(len(vals), time, np.uint64),
+                np.array(diffs, np.int64),
+            )
+        }
+
+
+GENERATORS = {
+    "tpch": TpchAdapter,
+    "auction": AuctionAdapter,
+    "counter": CounterAdapter,
+}
+
+
+class GeneratorSource:
+    """A running source: one writer per subsource shard, ticking on a
+    thread (or manually via tick_once for deterministic tests)."""
+
+    def __init__(
+        self,
+        client: PersistClient,
+        name: str,
+        generator: str,
+        options: dict,
+        shard_prefix: str,
+        tick_interval: float | None = 0.05,
+    ):
+        if generator not in GENERATORS:
+            raise ValueError(
+                f"unknown load generator {generator!r} "
+                f"(have: {sorted(GENERATORS)})"
+            )
+        self.name = name
+        # SQL option keys are space-separated words (SCALE FACTOR 0.1).
+        options = {
+            str(k).lower().replace(" ", "_"): v for k, v in options.items()
+        }
+        self.adapter = GENERATORS[generator](options)
+        self.shards = {
+            sub: f"{shard_prefix}_{sub}" for sub in self.adapter.subsources
+        }
+        self.writers: dict[str, WriteHandle] = {
+            sub: client.open_writer(self.shards[sub], schema)
+            for sub, schema in self.adapter.subsources.items()
+        }
+        self.tick_interval = tick_interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # Resume: the virtual time is the min subsource upper (all move
+        # in lockstep; min is safe after a partial crash).
+        self.t = min(w.upper for w in self.writers.values())
+        if self.t == 0:
+            self._append_all(self.adapter.snapshot(), 0)
+            self.t = 1
+
+    # -- ticking ------------------------------------------------------------
+    def _append_batch(self, w: WriteHandle, b, lower: int, upper: int):
+        batches = b if isinstance(b, list) else [b]
+        cols_parts = [x.to_columns() for x in batches]
+        n_cols = len(batches[0].schema.columns)
+        cols = [
+            np.concatenate([p[i] for p in cols_parts])
+            for i in range(n_cols)
+        ]
+        diff = np.concatenate([p[-1] for p in cols_parts])
+        nulls = []
+        for i in range(n_cols):
+            masks = [
+                np.asarray(x.nulls[i])[: len(p[0])]
+                if x.nulls[i] is not None
+                else None
+                for x, p in zip(batches, cols_parts)
+            ]
+            if all(m is None for m in masks):
+                nulls.append(None)
+            else:
+                nulls.append(
+                    np.concatenate(
+                        [
+                            m
+                            if m is not None
+                            else np.zeros(len(p[0]), np.bool_)
+                            for m, p in zip(masks, cols_parts)
+                        ]
+                    )
+                )
+        time = np.full(len(diff), lower, np.uint64)
+        w.compare_and_append(cols, nulls, time, diff, lower, upper)
+
+    def _append_all(self, batches: dict, t: int) -> None:
+        for sub, w in self.writers.items():
+            if w.upper > t:
+                continue  # already durable (resume after partial crash)
+            b = batches.get(sub)
+            if b is None:
+                w.compare_and_append(
+                    [
+                        np.zeros(0, c.dtype)
+                        for c in self.adapter.subsources[sub].columns
+                    ],
+                    [None] * len(self.adapter.subsources[sub].columns),
+                    np.zeros(0, np.uint64),
+                    np.zeros(0, np.int64),
+                    t,
+                    t + 1,
+                )
+            else:
+                self._append_batch(w, b, t, t + 1)
+
+    def tick_once(self) -> int:
+        """Advance every subsource by one tick; returns the new frontier."""
+        t = self.t
+        self._append_all(self.adapter.tick(t, t), t)
+        self.t = t + 1
+        return self.t
+
+    def start(self) -> None:
+        if self.tick_interval is None or self._thread is not None:
+            return
+
+        def run():
+            while not self._stop.is_set():
+                self.tick_once()
+                _time.sleep(self.tick_interval)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
