@@ -30,9 +30,11 @@ from ..sql.hir import PlanError
 from ..sql.plan import (
     CreateIndexPlan,
     CreateSourcePlan,
+    CreateTablePlan,
     CreateViewPlan,
     DropPlan,
     ExplainPlan,
+    InsertPlan,
     SelectPlan,
     ShowPlan,
     SubscribePlan,
@@ -40,7 +42,9 @@ from ..sql.plan import (
 )
 from ..storage.persist import PersistClient
 from ..transform.optimizer import optimize
+from ..storage.persist import WriteHandle
 from .controller import ComputeController
+from .oracle import TimestampOracle
 from .protocol import DataflowDescription
 from .sources import GeneratorSource
 
@@ -53,10 +57,11 @@ class ExecuteResult:
     """What a statement returns to the session (ExecuteResponse analog,
     adapter/src/command.rs)."""
 
-    kind: str  # "rows" | "text" | "ok"
+    kind: str  # "rows" | "text" | "ok" | "subscription"
     rows: list = field(default_factory=list)
     columns: tuple = ()
     text: str = ""
+    subscription: object = None
 
 
 class Coordinator:
@@ -68,11 +73,16 @@ class Coordinator:
         self.persist = persist
         self.catalog = SqlCatalog()
         self.controller = ComputeController()
-        # The timestamp oracle (oracle.py) joins when table writes land:
-        # generator sources carry their own per-source tick timelines, so
-        # reads select min(upper)-1 per shard set instead (the oracle is
-        # for the shared epoch-ms timeline of user tables).
+        # Tables share ONE timeline driven by the oracle (the reference's
+        # EpochMilliseconds timeline + txn-wal group commit: every write
+        # advances every table's upper to the same timestamp). Generator
+        # sources carry their own per-source tick timelines; reads select
+        # min(upper)-1 per involved shard set.
+        self.oracle = TimestampOracle(persist.consensus, "tables")
+        self._table_writers: dict[str, WriteHandle] = {}
         self.sources: dict[str, GeneratorSource] = {}
+        self.subscriptions: dict[int, Subscription] = {}
+        self._sub_seq = 0
         self.tick_interval = tick_interval
         # name -> installed dataflow name serving peeks for it
         self.peekable: dict[str, str] = {}
@@ -150,8 +160,14 @@ class Coordinator:
             return self._sequence_create_view(plan, sql, replay, record)
         if isinstance(plan, CreateIndexPlan):
             return self._sequence_create_index(plan, sql, replay, record)
+        if isinstance(plan, CreateTablePlan):
+            return self._sequence_create_table(plan, sql, replay, record)
+        if isinstance(plan, InsertPlan):
+            return self._sequence_insert(plan)
         if isinstance(plan, SelectPlan):
             return self._sequence_peek(plan)
+        if isinstance(plan, SubscribePlan):
+            return self._sequence_subscribe(plan)
         if isinstance(plan, DropPlan):
             return self._sequence_drop(plan)
         if isinstance(plan, ExplainPlan):
@@ -226,6 +242,126 @@ class Coordinator:
         src.start()
         return ExecuteResult("ok")
 
+    # -- tables --------------------------------------------------------------
+    def _sequence_create_table(
+        self, plan: CreateTablePlan, sql, replay, record
+    ) -> ExecuteResult:
+        if not replay:
+            self._check_name_free(plan.name)
+        if record is None:
+            record = self._record_ddl(sql, {"name": plan.name})
+        shard = f"u{record['id']}_table"
+        w = self.persist.open_writer(shard, plan.schema)
+        if w.upper == 0:
+            # Initialize the table at the timeline's current read time so
+            # it is immediately readable.
+            ts = self.oracle.read_ts()
+            w.compare_and_append(
+                [np.zeros(0, c.dtype) for c in plan.schema.columns],
+                [None] * plan.schema.arity,
+                np.zeros(0, np.uint64),
+                np.zeros(0, np.int64),
+                0,
+                ts + 1,
+            )
+        self._table_writers[plan.name] = w
+        self.catalog.create(
+            CatalogItem(
+                name=plan.name,
+                kind="table",
+                schema=plan.schema,
+                definition={"shard": shard},
+            )
+        )
+        return ExecuteResult("ok")
+
+    def _encode_insert(self, schema: Schema, rows: list):
+        cols, nulls = [], []
+        for j, col in enumerate(schema.columns):
+            vals = []
+            mask = []
+            for r in rows:
+                v = r[j]
+                mask.append(v is None)
+                if v is None:
+                    vals.append(0)
+                elif col.ctype is ColumnType.STRING:
+                    vals.append(GLOBAL_DICT.encode(str(v)))
+                elif col.ctype is ColumnType.DECIMAL:
+                    vals.append(round(float(v) * 10**col.scale))
+                elif col.ctype is ColumnType.BOOL:
+                    vals.append(bool(v))
+                else:
+                    vals.append(v)
+            cols.append(np.asarray(vals, dtype=col.dtype))
+            nulls.append(np.asarray(mask, bool) if any(mask) else None)
+        return cols, nulls
+
+    def _sequence_insert(self, plan: InsertPlan) -> ExecuteResult:
+        it = self.catalog.items.get(plan.table)
+        if it is None or it.kind != "table":
+            raise PlanError(f"{plan.table!r} is not an insertable table")
+        # Group commit on the shared table timeline (coord/appends.rs +
+        # txn-wal): allocate one write timestamp past every table upper,
+        # write the target table, advance all other tables to the same
+        # upper with empty appends, then apply the write to the oracle.
+        at_least = max(
+            (w.upper for w in self._table_writers.values()), default=0
+        )
+        ts = self.oracle.write_ts(at_least=at_least)
+        w = self._table_writers[plan.table]
+        cols, nulls = self._encode_insert(it.schema, plan.rows)
+        w.compare_and_append(
+            cols,
+            nulls,
+            np.full(len(plan.rows), ts, np.uint64),
+            np.ones(len(plan.rows), np.int64),
+            w.upper,
+            ts + 1,
+        )
+        for name, other in self._table_writers.items():
+            if name != plan.table and other.upper <= ts:
+                other.compare_and_append(
+                    [
+                        np.zeros(0, c.dtype)
+                        for c in self.catalog.items[name].schema.columns
+                    ],
+                    [None] * self.catalog.items[name].schema.arity,
+                    np.zeros(0, np.uint64),
+                    np.zeros(0, np.int64),
+                    other.upper,
+                    ts + 1,
+                )
+        self.oracle.apply_write(ts)
+        return ExecuteResult("ok")
+
+    # -- subscribe ------------------------------------------------------------
+    def _sequence_subscribe(self, plan: SubscribePlan) -> ExecuteResult:
+        expr = optimize(self._inline_views(plan.expr))
+        imports = self._source_imports(expr)
+        self._sub_seq += 1
+        # Unique across coordinator restarts: the sink shard is durable,
+        # so a process-local counter alone would tail a STALE shard from
+        # a previous run's different subscription.
+        import uuid
+
+        name = f"sub{self._sub_seq}-{uuid.uuid4().hex[:8]}"
+        shard = f"{name}_out"
+        self._register_dataflow(
+            DataflowDescription(
+                name=name,
+                expr=expr,
+                source_imports=imports,
+                sink_shard=shard,
+            )
+        )
+        sub = Subscription(self, name, shard, expr.schema(),
+                           plan.column_names)
+        self.subscriptions[self._sub_seq] = sub
+        res = ExecuteResult("subscription", columns=plan.column_names)
+        res.subscription = sub
+        return res
+
     def _inline_views(self, expr: mir.RelationExpr) -> mir.RelationExpr:
         """Replace Get(view) with the view's definition so rendered
         dataflows bottom out at sources (view inlining; the reference
@@ -252,9 +388,7 @@ class Coordinator:
                 it = self.catalog.items.get(e.name)
                 if it is None:
                     raise PlanError(f"unknown relation {e.name!r}")
-                if it.kind == "source":
-                    imports[e.name] = (it.definition["shard"], it.schema)
-                elif it.kind == "materialized-view":
+                if it.kind in ("source", "materialized-view", "table"):
                     imports[e.name] = (it.definition["shard"], it.schema)
                 else:
                     raise PlanError(
@@ -403,7 +537,10 @@ class Coordinator:
         "view": {"view", "materialized-view"},
         "source": {"source"},
         "index": {"index"},
-        "object": {"view", "materialized-view", "source", "index"},
+        "table": {"table"},
+        "object": {
+            "view", "materialized-view", "source", "index", "table",
+        },
     }
 
     def _sequence_drop(self, plan: DropPlan) -> ExecuteResult:
@@ -450,6 +587,8 @@ class Coordinator:
                 src.stop()
                 for sub in src.adapter.subsources:
                     self.catalog.drop(sub, if_exists=True)
+        elif it.kind == "table":
+            self._table_writers.pop(name, None)
         self.catalog.drop(name)
         return ExecuteResult("ok")
 
@@ -514,19 +653,74 @@ class Coordinator:
         return max(min(uppers) - 1, 0)
 
     def shutdown(self) -> None:
+        for sub in list(self.subscriptions.values()):
+            sub.close()
         for src in self.sources.values():
             src.stop()
         self.controller.shutdown()
 
 
+class Subscription:
+    """SUBSCRIBE: a maintained delta stream of a query's result
+    (sink/subscribe.rs + SUBSCRIBE semantics): the first poll returns
+    the snapshot, subsequent polls return (data, diff) events stamped
+    with the virtual time, interleaved with progress frontiers. Tailing
+    the dataflow's sink shard gives exactly-once delivery across
+    coordinator restarts."""
+
+    def __init__(self, coord, df_name, shard, schema, columns):
+        self.coord = coord
+        self.df_name = df_name
+        self.reader = coord.persist.open_reader(shard, f"sub-{df_name}")
+        self.schema = schema
+        self.columns = columns
+        self.frontier = 0
+        self.closed = False
+
+    def poll(self, timeout: float = 5.0):
+        """Returns (events, progress_frontier) or None on timeout. Each
+        event is (vals..., time, diff) with strings decoded and NULLs as
+        None."""
+        got = self.reader.listen_next(self.frontier, timeout)
+        if got is None:
+            return None
+        (_sch, cols, nulls, time, diff), upper = got
+        if not cols and self.schema.arity:
+            cols = [np.zeros(0, c.dtype) for c in self.schema.columns]
+            nulls = [None] * self.schema.arity
+        from ..repr.schema import decode_result_rows
+
+        events = decode_result_rows(self.schema, cols, nulls, time, diff)
+        self.frontier = upper
+        return events, upper
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self.coord.subscriptions = {
+            k: v for k, v in self.coord.subscriptions.items() if v is not self
+        }
+        self.coord.controller.drop_dataflow(self.df_name)
+        self.coord._df_upstream.pop(self.df_name, None)
+        self.reader.expire()
+
+
 def _finish(rows: list) -> list:
     """Collapse (cols..., time, diff) into SELECT result rows with
-    multiplicities expanded (RowSetFinishing application, coord/peek.rs)."""
+    multiplicities expanded (RowSetFinishing application, coord/peek.rs).
+    NULLs (None) sort first, as in the reference's Datum ordering."""
     acc: dict = {}
     for r in rows:
         acc[r[:-2]] = acc.get(r[:-2], 0) + r[-1]
+
+    def key(vals):
+        return tuple((v is not None, v if v is not None else 0)
+                     for v in vals)
+
     out = []
-    for vals, mult in sorted(acc.items()):
+    for vals in sorted(acc.keys(), key=key):
+        mult = acc[vals]
         if mult < 0:
             raise RuntimeError(
                 f"negative multiplicity {mult} for row {vals} "

@@ -36,6 +36,24 @@ from . import protocol as ctp
 from .protocol import DataflowDescription, PersistLocation
 
 
+def _result_rows(batch) -> list:
+    """Batch -> decoded result rows (strings decoded, NULLs as None):
+    dictionary codes never cross the wire raw — the controller may live
+    in another process."""
+    import numpy as np
+
+    from ..repr.schema import decode_result_rows
+
+    n = int(batch.count)
+    return decode_result_rows(
+        batch.schema,
+        [np.asarray(c)[:n] for c in batch.cols],
+        [None if nl is None else np.asarray(nl)[:n] for nl in batch.nulls],
+        np.asarray(batch.time)[:n],
+        np.asarray(batch.diff)[:n],
+    )
+
+
 class _Installed:
     """A running dataflow + its shipped description fingerprint (for
     reconciliation) and read-hold bookkeeping."""
@@ -314,7 +332,7 @@ class ReplicaWorker:
             if as_of is not None and inst.view.upper <= as_of:
                 keep.append(p)  # not yet complete at as_of
                 continue
-            rows = inst.view.peek()
+            rows = _result_rows(inst.view.df.output.batch)
             ctp.send_msg(
                 conn,
                 {
@@ -369,6 +387,16 @@ def serve_forever(
 
 
 def main() -> None:
+    import os
+
+    # The axon TPU plugin ignores the JAX_PLATFORMS env var; honor it
+    # here via the config knob (before any backend initialization) so
+    # orchestrators can pin replicas to cpu/tpu explicitly.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     ap = argparse.ArgumentParser(description="materialize_tpu replica")
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--blob", required=True)

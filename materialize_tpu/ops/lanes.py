@@ -13,12 +13,15 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..repr.batch import Batch
 from ..repr.schema import ColumnType
 
-_SIGN64 = jnp.uint64(1 << 63)
-_SIGN32 = jnp.uint32(1 << 31)
+# numpy scalars, not jnp: a module-level jnp constant would
+# initialize the JAX backend (and contact the TPU tunnel) at import.
+_SIGN64 = np.uint64(1 << 63)
+_SIGN32 = np.uint32(1 << 31)
 
 
 # Greedy power-of-two normalization rungs: sum must cover the full f64
@@ -97,7 +100,10 @@ def _f64_lanes(arr: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
 def column_lanes(arr: jnp.ndarray, ctype: ColumnType) -> tuple[jnp.ndarray, ...]:
     """Encode one column as uint64 lane(s) with order-preserving
     lexicographic comparison. All types yield one lane except FLOAT64,
-    which yields two (exponent, mantissa)."""
+    which yields two (exponent, mantissa). Output is always a jnp array
+    (numpy inputs + numpy sign constants would otherwise stay numpy and
+    break traced indexing downstream)."""
+    arr = jnp.asarray(arr)
     if ctype is ColumnType.BOOL:
         return (arr.astype(jnp.uint64),)
     if ctype in (

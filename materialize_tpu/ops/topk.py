@@ -29,6 +29,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..arrangement.spine import Arrangement, arrange, insert
 from ..ops.consolidate import consolidate
@@ -38,7 +39,7 @@ from ..ops.sort import compact, concat_batches, segment_ids, segment_starts
 from ..repr.batch import Batch
 from ..repr.schema import Column, ColumnType, Schema
 
-_SIGN64 = jnp.uint64(1 << 63)
+_SIGN64 = np.uint64(1 << 63)  # numpy: no backend init at import
 _NO_LIMIT = 1 << 62
 
 

@@ -163,3 +163,23 @@ class StringDictionary:
 # overkill for now: a single shared dictionary per process is correct (codes
 # are only compared for equality) and keeps joins on string columns trivial.
 GLOBAL_DICT = StringDictionary()
+
+
+def decode_result_rows(schema: Schema, cols, nulls, time, diff) -> list:
+    """Host update arrays -> result rows (vals..., time, diff) with
+    STRING dictionary codes decoded to Python strings and NULLs as None.
+    Codes are PROCESS-LOCAL, so every surface that hands rows across a
+    process boundary (peek responses, SUBSCRIBE events) must decode
+    through this one helper."""
+    out = []
+    for i in range(len(diff)):
+        vals = []
+        for j, col in enumerate(schema.columns):
+            if nulls[j] is not None and bool(nulls[j][i]):
+                vals.append(None)
+            elif col.ctype is ColumnType.STRING:
+                vals.append(GLOBAL_DICT.decode(int(cols[j][i])))
+            else:
+                vals.append(cols[j][i].item())
+        out.append(tuple(vals) + (int(time[i]), int(diff[i])))
+    return out

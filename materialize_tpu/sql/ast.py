@@ -264,6 +264,19 @@ class CreateSource(Statement):
 
 
 @dataclass(frozen=True)
+class CreateTable(Statement):
+    name: str
+    columns: tuple  # (name, type_name, nullable) triples
+
+
+@dataclass(frozen=True)
+class Insert(Statement):
+    table: str
+    rows: tuple  # tuple of tuples of Expr (constant values)
+    columns: tuple = ()  # optional explicit column list
+
+
+@dataclass(frozen=True)
 class DropObject(Statement):
     kind: str  # view/index/source
     name: str

@@ -101,8 +101,25 @@ class Parser:
             return self._create()
         if self.accept_kw("drop"):
             return self._drop()
+        if self.accept_kw("insert"):
+            return self._insert()
         if self.accept_kw("subscribe"):
             self.accept_kw("to")
+            t = self.peek()
+            # A bare relation name (keywords double as identifiers here,
+            # as everywhere expect_ident does — relations may be named
+            # 'counter' etc.): SUBSCRIBE r == SUBSCRIBE (SELECT * FROM r)
+            if (
+                t.kind is TokKind.IDENT
+                or (
+                    t.kind is TokKind.KEYWORD
+                    and t.text not in ("select", "with", "values")
+                )
+            ):
+                name = self.expect_ident()
+                return ast.Subscribe(
+                    Parser(f"SELECT * FROM {name}").parse_query()
+                )
             return ast.Subscribe(self.parse_query())
         if self.accept_kw("show"):
             kind = self.expect_ident()
@@ -122,6 +139,8 @@ class Parser:
             return self._create_view(materialized=False, or_replace=or_replace)
         if self.accept_kw("source"):
             return self._create_source()
+        if self.accept_kw("table"):
+            return self._create_table()
         if self.accept_kw("default"):
             self.expect_kw("index")
             self.expect_kw("on")
@@ -141,6 +160,69 @@ class Parser:
                 key = tuple(exprs)
             return ast.CreateIndex(name, on, key)
         raise ParseError(f"unsupported CREATE at {self.peek().pos}")
+
+    def _create_table(self) -> ast.Statement:
+        name = self.expect_ident()
+        self.expect_sym("(")
+        columns = []
+        while True:
+            col = self.expect_ident()
+            type_parts = [self.expect_ident()]
+            if (
+                type_parts[0].lower() == "double"
+                and self.peek().kind is TokKind.IDENT
+                and self.peek().text.lower() == "precision"
+            ):
+                self.next()
+                type_parts[0] = "double precision"
+            # numeric(p, s) / decimal(p, s)
+            if self.accept_sym("("):
+                args = [self.expect_ident_or_number()]
+                while self.accept_sym(","):
+                    args.append(self.expect_ident_or_number())
+                self.expect_sym(")")
+                type_parts.append("(" + ",".join(args) + ")")
+            nullable = True
+            if self.accept_kw("not"):
+                self.expect_kw("null")
+                nullable = False
+            elif self.accept_kw("null"):
+                pass
+            columns.append((col, "".join(type_parts), nullable))
+            if not self.accept_sym(","):
+                break
+        self.expect_sym(")")
+        return ast.CreateTable(name, tuple(columns))
+
+    def expect_ident_or_number(self) -> str:
+        t = self.peek()
+        if t.kind is TokKind.NUMBER:
+            self.next()
+            return t.text
+        return self.expect_ident()
+
+    def _insert(self) -> ast.Statement:
+        self.expect_kw("into")
+        table = self.expect_ident()
+        columns: tuple = ()
+        if self.accept_sym("("):
+            cols = [self.expect_ident()]
+            while self.accept_sym(","):
+                cols.append(self.expect_ident())
+            self.expect_sym(")")
+            columns = tuple(cols)
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_sym("(")
+            vals = [self.parse_expr()]
+            while self.accept_sym(","):
+                vals.append(self.parse_expr())
+            self.expect_sym(")")
+            rows.append(tuple(vals))
+            if not self.accept_sym(","):
+                break
+        return ast.Insert(table, tuple(rows), columns)
 
     def _create_view(self, materialized: bool, or_replace: bool):
         name = self.expect_ident()

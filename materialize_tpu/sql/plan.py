@@ -60,6 +60,18 @@ class DropPlan(Plan):
 
 
 @dataclass
+class CreateTablePlan(Plan):
+    name: str
+    schema: Schema
+
+
+@dataclass
+class InsertPlan(Plan):
+    table: str
+    rows: list  # python value tuples, coerced to the table schema
+
+
+@dataclass
 class SubscribePlan(Plan):
     expr: mir.RelationExpr
     column_names: tuple
@@ -109,6 +121,10 @@ def _plan(stmt: ast.Statement, catalog: CatalogInterface) -> Plan:
         return CreateSourcePlan(stmt.name, stmt.generator, stmt.options)
     if isinstance(stmt, ast.DropObject):
         return DropPlan(stmt.kind, stmt.name, stmt.if_exists)
+    if isinstance(stmt, ast.CreateTable):
+        return CreateTablePlan(stmt.name, _table_schema(stmt.columns))
+    if isinstance(stmt, ast.Insert):
+        return _plan_insert(stmt, catalog)
     if isinstance(stmt, ast.Subscribe):
         hir_rel, scope = qp.plan_query(stmt.query)
         return SubscribePlan(
@@ -119,6 +135,76 @@ def _plan(stmt: ast.Statement, catalog: CatalogInterface) -> Plan:
     if isinstance(stmt, ast.ShowObjects):
         return ShowPlan(stmt.kind)
     raise PlanError(f"cannot plan {type(stmt).__name__}")
+
+
+def _table_schema(columns) -> Schema:
+    """CREATE TABLE column list -> Schema; the type-name mapping is the
+    planner's (hir.type_from_name) — only decimal(p,s) scale parsing
+    lives here."""
+    from ..repr.schema import Column
+    from .hir import type_from_name
+
+    cols = []
+    for name, type_name, nullable in columns:
+        t = type_name.lower()
+        scale = 0
+        base = t
+        if "(" in t:
+            base = t[: t.index("(")]
+            args = t[t.index("(") + 1 : t.rindex(")")].split(",")
+            if base in ("decimal", "numeric") and len(args) > 1:
+                scale = int(args[1])
+        cols.append(Column(name, type_from_name(base), nullable, scale))
+    return Schema(cols)
+
+
+def _eval_literal(e: ast.Expr):
+    if isinstance(e, ast.NumberLit):
+        return float(e.text) if "." in e.text or "e" in e.text.lower() \
+            else int(e.text)
+    if isinstance(e, ast.StringLit):
+        return e.value
+    if isinstance(e, ast.BoolLit):
+        return e.value
+    if isinstance(e, ast.NullLit):
+        return None
+    if isinstance(e, ast.UnaryOp) and e.op == "-":
+        v = _eval_literal(e.expr)
+        return -v if v is not None else None
+    raise PlanError(
+        f"INSERT values must be constants, got {type(e).__name__}"
+    )
+
+
+def _plan_insert(stmt: ast.Insert, catalog: CatalogInterface) -> Plan:
+    schema = catalog.resolve_item(stmt.table)
+    names = list(schema.names)
+    if stmt.columns:
+        order = []
+        for c in stmt.columns:
+            if c not in names:
+                raise PlanError(
+                    f"unknown column {c!r} in table {stmt.table!r}"
+                )
+            order.append(names.index(c))
+    else:
+        order = list(range(len(names)))
+    rows = []
+    for r in stmt.rows:
+        if len(r) != len(order):
+            raise PlanError(
+                f"INSERT row has {len(r)} values, expected {len(order)}"
+            )
+        full = [None] * len(names)
+        for slot, e in zip(order, r):
+            full[slot] = _eval_literal(e)
+        for i, col in enumerate(schema.columns):
+            if full[i] is None and not col.nullable:
+                raise PlanError(
+                    f"null value in non-nullable column {col.name!r}"
+                )
+        rows.append(tuple(full))
+    return InsertPlan(stmt.table, rows)
 
 
 def _explain(stmt: ast.Explain, catalog: CatalogInterface) -> Plan:

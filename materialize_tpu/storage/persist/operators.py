@@ -34,8 +34,15 @@ def updates_to_batch(
     capacity: int | None = None,
 ) -> Batch:
     """Host update arrays -> device Batch with times forwarded to as_of
-    (the step processes one virtual timestamp; logical compaction)."""
+    (the step processes one virtual timestamp; logical compaction).
+
+    A fetch that covered only empty upper-advances decodes to ZERO
+    column arrays (there were no parts); the batch must still carry the
+    declared schema's arity or downstream operators index out of range."""
     n = len(diff)
+    if not cols and schema.arity:
+        cols = [np.zeros(0, c.dtype) for c in schema.columns]
+        nulls = [None] * schema.arity
     return Batch.from_numpy(
         schema,
         cols,

@@ -231,6 +231,113 @@ class TestCoordinator:
             (0,), (2,),
         ]
 
+    def test_tables_insert_select(self, cluster):
+        coord = cluster()
+        coord.execute(
+            "CREATE TABLE people (id bigint NOT NULL, name text, "
+            "age int)"
+        )
+        coord.execute(
+            "INSERT INTO people VALUES (1, 'ada', 36), (2, 'grace', NULL)"
+        )
+        coord.execute("INSERT INTO people (id, name) VALUES (3, 'alan')")
+        res = coord.execute("SELECT id, name, age FROM people")
+        assert res.rows == [
+            (1, "ada", 36), (2, "grace", None), (3, "alan", None),
+        ]
+
+    def test_table_group_commit_joined_read(self, cluster):
+        """Two tables share the timeline: a read after writes to both
+        sees a consistent joint snapshot (txn-wal en-masse uppers)."""
+        coord = cluster()
+        coord.execute("CREATE TABLE a (k bigint NOT NULL, v bigint)")
+        coord.execute("CREATE TABLE b (k bigint NOT NULL, w bigint)")
+        coord.execute("INSERT INTO a VALUES (1, 10)")
+        coord.execute("INSERT INTO b VALUES (1, 20)")
+        res = coord.execute(
+            "SELECT a.k, v, w FROM a, b WHERE a.k = b.k"
+        )
+        assert res.rows == [(1, 10, 20)]
+        coord.execute(
+            "CREATE MATERIALIZED VIEW joined AS "
+            "SELECT a.k AS k, v, w FROM a, b WHERE a.k = b.k"
+        )
+        coord.execute("INSERT INTO a VALUES (2, 11)")
+        coord.execute("INSERT INTO b VALUES (2, 21)")
+        res = coord.execute("SELECT * FROM joined")
+        assert sorted(res.rows) == [(1, 10, 20), (2, 11, 21)]
+
+    def test_tables_survive_restart(self, cluster):
+        coord = cluster()
+        coord.execute("CREATE TABLE t (x bigint NOT NULL)")
+        coord.execute("INSERT INTO t VALUES (7)")
+        coord.shutdown()
+        coord2 = cluster()
+        coord2.execute("INSERT INTO t VALUES (8)")
+        assert coord2.execute("SELECT x FROM t").rows == [(7,), (8,)]
+
+    def test_select_sorts_nulls_first(self, cluster):
+        coord = cluster()
+        coord.execute("CREATE TABLE t (x int, y text)")
+        coord.execute(
+            "INSERT INTO t VALUES (2, 'b'), (NULL, 'a'), (1, NULL)"
+        )
+        res = coord.execute("SELECT x, y FROM t")
+        assert res.rows == [(None, "a"), (1, None), (2, "b")]
+
+    def test_mv_survives_empty_group_commit_advances(self, cluster):
+        """Writes to table a advance table b's upper with EMPTY chunks;
+        an MV over b must step through them (regression: arity-0 batch
+        from a parts-free fetch killed the dataflow)."""
+        coord = cluster()
+        coord.execute("CREATE TABLE a (x bigint NOT NULL)")
+        coord.execute("CREATE TABLE b (y bigint NOT NULL)")
+        coord.execute("INSERT INTO b VALUES (5)")
+        coord.execute(
+            "CREATE MATERIALIZED VIEW mb AS SELECT count(*) FROM b"
+        )
+        for i in range(4):
+            coord.execute(f"INSERT INTO a VALUES ({i})")
+        assert coord.execute("SELECT * FROM mb").rows == [(1,)]
+        assert not coord.controller.statuses, list(
+            coord.controller.statuses
+        )
+
+    def test_subscribe_not_stale_after_restart(self, cluster):
+        """A new coordinator's first SUBSCRIBE must not tail a durable
+        sink shard left by a previous run's subscription."""
+        coord = cluster()
+        coord.execute("CREATE TABLE t (x bigint NOT NULL)")
+        coord.execute("INSERT INTO t VALUES (100)")
+        sub = coord.execute("SUBSCRIBE t").subscription
+        events, _ = sub.poll(timeout=30)
+        assert [(e[0], e[-1]) for e in events] == [(100, 1)]
+        coord.shutdown()
+        coord2 = cluster()
+        coord2.execute("CREATE TABLE u (y bigint NOT NULL)")
+        coord2.execute("INSERT INTO u VALUES (999)")
+        sub2 = coord2.execute("SUBSCRIBE u").subscription
+        events2, _ = sub2.poll(timeout=30)
+        assert [(e[0], e[-1]) for e in events2] == [(999, 1)]
+        sub2.close()
+
+    def test_subscribe_snapshot_then_deltas(self, cluster):
+        coord = cluster()
+        coord.execute("CREATE SOURCE c FROM LOAD GENERATOR counter")
+        res = coord.execute(
+            "SUBSCRIBE TO (SELECT count(*) AS n FROM counter)"
+        )
+        assert res.kind == "subscription"
+        sub = res.subscription
+        events, frontier = sub.poll(timeout=30)
+        # Snapshot: count = 1 (value 0 at t=0).
+        assert [(e[0], e[-1]) for e in events] == [(1, 1)]
+        coord.sources["c"].tick_once()
+        events2, _ = sub.poll(timeout=30)
+        # Delta: retract 1, assert 2.
+        assert sorted((e[0], e[-1]) for e in events2) == [(1, -1), (2, 1)]
+        sub.close()
+
     def test_tpch_q1_through_sql(self, cluster):
         coord = cluster()
         coord.execute(
@@ -262,11 +369,16 @@ class TestCoordinator:
         ls = li.index_of("l_linestatus")
         qty = li.index_of("l_quantity")
         sd = li.index_of("l_shipdate")
+        from materialize_tpu.repr.schema import GLOBAL_DICT
+
         acc: dict = {}
         for i in range(len(diff)):
             if int(cols[sd][i]) > 10000:
                 continue
-            key = (int(cols[rf][i]), int(cols[ls][i]))
+            key = (
+                GLOBAL_DICT.decode(int(cols[rf][i])),
+                GLOBAL_DICT.decode(int(cols[ls][i])),
+            )
             n, s = acc.get(key, (0, 0))
             acc[key] = (
                 n + int(diff[i]),
