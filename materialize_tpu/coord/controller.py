@@ -128,6 +128,7 @@ class ComputeController:
         # Observed state (guarded by _lock: mutated by the absorber
         # thread, read by caller threads).
         self.frontiers: dict[str, dict[str, int]] = {}  # df -> replica -> upper
+        self.arrangement_records: dict[str, dict[str, int]] = {}
         self.statuses: deque = deque(maxlen=1000)  # replica error reports
         self._peek_results: dict[int, dict] = {}
         self._peek_events: dict[int, threading.Event] = {}
@@ -177,6 +178,7 @@ class ComputeController:
         with self._lock:
             self._dataflows.pop(name, None)
             self.frontiers.pop(name, None)
+            self.arrangement_records.pop(name, None)
         self._broadcast(ctp.drop_dataflow(name))
 
     def allow_compaction(self, dataflow: str, since: int) -> None:
@@ -232,6 +234,10 @@ class ComputeController:
                             self.frontiers.setdefault(df, {})[
                                 replica
                             ] = upper
+                        for df, n in msg.get("records", {}).items():
+                            self.arrangement_records.setdefault(df, {})[
+                                replica
+                            ] = n
             elif kind == "Status":
                 with self._lock:
                     self.statuses.append(msg)

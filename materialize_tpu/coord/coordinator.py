@@ -43,7 +43,9 @@ from ..sql.plan import (
 from ..storage.persist import PersistClient
 from ..transform.optimizer import optimize
 from ..storage.persist import WriteHandle
+from ..utils.dyncfg import COMPUTE_CONFIGS
 from .controller import ComputeController
+from .replica import _result_rows as _decode_peek_rows
 from .oracle import TimestampOracle
 from .protocol import DataflowDescription
 from .sources import GeneratorSource
@@ -96,6 +98,14 @@ class Coordinator:
         self._item_seq = 0
         self._transient_seq = 0
         self._lock = threading.RLock()
+        # Introspection relations (mz_internal analog): virtual items
+        # resolved to snapshots at peek time (introspection.py).
+        from .introspection import INTROSPECTION_SCHEMAS
+
+        for name, schema in INTROSPECTION_SCHEMAS.items():
+            self.catalog.create(
+                CatalogItem(name=name, kind="introspection", schema=schema)
+            )
         self._bootstrap()
 
     # -- replicas -----------------------------------------------------------
@@ -379,12 +389,25 @@ class Coordinator:
         return walk(expr)
 
     def _source_imports(self, expr: mir.RelationExpr) -> dict:
-        """Every Get leaf must be a source subsource or a maintained MV
-        shard: name -> (shard, schema)."""
+        """Every FREE Get leaf must be a source subsource, table, or
+        maintained MV shard: name -> (shard, schema). Let/LetRec-bound
+        names are not imports."""
         imports: dict = {}
 
-        def walk(e):
+        def walk(e, bound: frozenset):
+            if isinstance(e, mir.Let):
+                walk(e.value, bound)
+                walk(e.body, bound | {e.name})
+                return
+            if isinstance(e, mir.LetRec):
+                inner = bound | set(e.names)
+                for v in e.values:
+                    walk(v, inner)
+                walk(e.body, inner)
+                return
             if isinstance(e, mir.Get):
+                if e.name in bound:
+                    return
                 it = self.catalog.items.get(e.name)
                 if it is None:
                     raise PlanError(f"unknown relation {e.name!r}")
@@ -396,9 +419,9 @@ class Coordinator:
                         "readable; create an index or materialize it"
                     )
             for c in e.children():
-                walk(c)
+                walk(c, bound)
 
-        walk(expr)
+        walk(expr, frozenset())
         return imports
 
     def _check_name_free(self, name: str, or_replace: bool = False) -> None:
@@ -593,8 +616,72 @@ class Coordinator:
         return ExecuteResult("ok")
 
     # -- peeks ---------------------------------------------------------------
+    def _introspection_names(self, expr) -> set | None:
+        """The introspection relations referenced by free Gets, or None
+        if any free Get is NOT introspection (mixing is unsupported)."""
+        names: set = set()
+        non: list = []
+
+        def walk(e, bound):
+            if isinstance(e, mir.Let):
+                walk(e.value, bound)
+                walk(e.body, bound | {e.name})
+                return
+            if isinstance(e, mir.LetRec):
+                inner = bound | set(e.names)
+                for v in e.values:
+                    walk(v, inner)
+                walk(e.body, inner)
+                return
+            if isinstance(e, mir.Get) and e.name not in bound:
+                it = self.catalog.items.get(e.name)
+                if it is not None and it.kind == "introspection":
+                    names.add(e.name)
+                else:
+                    non.append(e.name)
+            for c in e.children():
+                walk(c, bound)
+
+        walk(expr, frozenset())
+        if not names:
+            return None
+        if non:
+            raise PlanError(
+                "queries mixing introspection and ordinary relations "
+                f"are not supported (introspection: {sorted(names)}, "
+                f"other: {sorted(set(non))})"
+            )
+        return names
+
+    def _sequence_introspection_peek(self, plan, expr) -> ExecuteResult:
+        """Evaluate entirely coordinator-side: substitute snapshots as
+        Constants and run one local dataflow step (full SQL surface over
+        introspection state)."""
+        from ..render.dataflow import Dataflow
+        from .introspection import snapshot
+
+        def subst(e):
+            if isinstance(e, mir.Get):
+                it = self.catalog.items.get(e.name)
+                if it is not None and it.kind == "introspection":
+                    rows = tuple(
+                        (vals, 1) for vals in snapshot(self, e.name)
+                    )
+                    return mir.Constant(rows, it.schema)
+                return e
+            return _rewrite_children(e, subst)
+
+        df = Dataflow(subst(expr))
+        df.step({})
+        rows = _decode_peek_rows(df.output.batch)
+        return ExecuteResult(
+            "rows", rows=_finish(rows), columns=plan.column_names
+        )
+
     def _sequence_peek(self, plan: SelectPlan) -> ExecuteResult:
         expr = optimize(self._inline_views(plan.expr))
+        if self._introspection_names(expr) is not None:
+            return self._sequence_introspection_peek(plan, expr)
         # Fast path (peek.rs fast-path detection): a bare Get of a
         # peekable (indexed / materialized) relation. Timestamp
         # selection (coord/timestamp_selection.rs): read at the latest
@@ -652,6 +739,12 @@ class Coordinator:
             return 0
         return max(min(uppers) - 1, 0)
 
+    def update_config(self, values: dict) -> None:
+        """Apply dyncfg updates and propagate to replicas in
+        command-stream order (dyncfg sync + UpdateConfiguration)."""
+        full = COMPUTE_CONFIGS.update(values)
+        self.controller.update_configuration(full)
+
     def shutdown(self) -> None:
         for sub in list(self.subscriptions.values()):
             sub.close()
@@ -685,9 +778,6 @@ class Subscription:
         if got is None:
             return None
         (_sch, cols, nulls, time, diff), upper = got
-        if not cols and self.schema.arity:
-            cols = [np.zeros(0, c.dtype) for c in self.schema.columns]
-            nulls = [None] * self.schema.arity
         from ..repr.schema import decode_result_rows
 
         events = decode_result_rows(self.schema, cols, nulls, time, diff)

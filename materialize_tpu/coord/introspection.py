@@ -1,0 +1,144 @@
+"""Introspection relations: the mz_internal / mz_catalog analog.
+
+The reference renders timely/differential/compute event logs as
+arrangements queryable through hundreds of ``mz_internal`` relations
+(``compute/src/logging/*``, ``catalog/src/builtin.rs``). The TPU
+re-cast: introspection relations are *virtual* — each has a schema and a
+snapshot function over coordinator state (catalog, controller frontiers,
+arrangement sizes, metrics, trace spans); a SELECT that references only
+introspection relations is evaluated coordinator-side by substituting
+the snapshots as constants into the plan and running it through the
+ordinary dataflow renderer, so the FULL SQL surface (joins, aggregates,
+ORDER BY) works over them.
+"""
+
+from __future__ import annotations
+
+from ..repr.schema import GLOBAL_DICT, Column, ColumnType, Schema
+
+S = ColumnType.STRING
+I = ColumnType.INT64
+F = ColumnType.FLOAT64
+
+
+def _enc(s: str) -> int:
+    return GLOBAL_DICT.encode(s)
+
+
+INTROSPECTION_SCHEMAS: dict[str, Schema] = {
+    "mz_objects": Schema(
+        [Column("id", I), Column("name", S), Column("type", S)]
+    ),
+    "mz_sources": Schema(
+        [Column("name", S), Column("generator", S), Column("tick", I)]
+    ),
+    "mz_dataflows": Schema(
+        [Column("name", S), Column("sink_shard", S), Column("on", S)]
+    ),
+    "mz_dataflow_frontiers": Schema(
+        [Column("dataflow", S), Column("replica", S), Column("upper", I)]
+    ),
+    "mz_arrangement_sizes": Schema(
+        [Column("dataflow", S), Column("replica", S), Column("records", I)]
+    ),
+    "mz_metrics": Schema(
+        [Column("metric", S), Column("value", F)]
+    ),
+    "mz_trace_spans": Schema(
+        [
+            Column("name", S),
+            Column("level", S),
+            Column("duration_us", I),
+        ]
+    ),
+    "mz_cluster_replicas": Schema(
+        [Column("name", S), Column("connected", I)]
+    ),
+}
+
+
+def snapshot(coord, name: str) -> list[tuple]:
+    """Current rows of one introspection relation (values already
+    dictionary-encoded for Constant substitution)."""
+    if name == "mz_objects":
+        rows = []
+        for i, it in enumerate(sorted(
+            coord.catalog.items.values(), key=lambda x: x.name
+        )):
+            rows.append((i, _enc(it.name), _enc(it.kind)))
+        return rows
+    if name == "mz_sources":
+        return [
+            (_enc(n), _enc(type(src.adapter).__name__), src.t)
+            for n, src in sorted(coord.sources.items())
+        ]
+    if name == "mz_dataflows":
+        rows = []
+        for it in sorted(
+            coord.catalog.items.values(), key=lambda x: x.name
+        ):
+            if it.kind == "materialized-view":
+                rows.append(
+                    (
+                        _enc(it.name),
+                        _enc(it.definition["shard"]),
+                        _enc(it.name),
+                    )
+                )
+            elif it.kind == "index":
+                rows.append(
+                    (_enc(it.name), _enc(""), _enc(it.definition["on"]))
+                )
+        return rows
+    if name == "mz_dataflow_frontiers":
+        with coord.controller._lock:
+            snap = {
+                df: dict(per)
+                for df, per in coord.controller.frontiers.items()
+            }
+        return [
+            (_enc(df), _enc(rep), upper)
+            for df, per in sorted(snap.items())
+            for rep, upper in sorted(per.items())
+        ]
+    if name == "mz_arrangement_sizes":
+        with coord.controller._lock:
+            snap = {
+                df: dict(per)
+                for df, per in coord.controller.arrangement_records.items()
+            }
+        return [
+            (_enc(df), _enc(rep), n)
+            for df, per in sorted(snap.items())
+            for rep, n in sorted(per.items())
+        ]
+    if name == "mz_metrics":
+        from ..utils.metrics import REGISTRY
+
+        rows = []
+        for m in sorted(
+            REGISTRY._metrics.values(), key=lambda m: m.name
+        ):
+            for sname, labels, value in m.samples():
+                full = sname + (
+                    "{" + ",".join(
+                        f"{k}={v}" for k, v in sorted(labels.items())
+                    ) + "}"
+                    if labels
+                    else ""
+                )
+                rows.append((_enc(full), float(value)))
+        return rows
+    if name == "mz_trace_spans":
+        from ..utils.trace import TRACER
+
+        return [
+            (_enc(r.name), _enc(r.level), int(r.duration * 1e6))
+            for r in TRACER.records()
+        ]
+    if name == "mz_cluster_replicas":
+        return [
+            (_enc(n), int(rc.connected.is_set()))
+            for n, rc in sorted(coord.controller.replicas.items())
+        ]
+    raise KeyError(name)

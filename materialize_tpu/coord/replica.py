@@ -301,15 +301,29 @@ class ReplicaWorker:
                 if p["peek_id"] != cmd["peek_id"]
             ]
         elif kind == "AllowCompaction":
+            from ..utils.dyncfg import (
+                ARRANGEMENT_COMPACTION_BATCHES,
+                COMPUTE_CONFIGS,
+            )
+
             inst = self.dataflows.get(cmd["dataflow"])
             if inst is not None:
                 for s in inst.view.sources.values():
                     s.reader.downgrade_since(cmd["since"])
-                    s.reader.machine.maybe_compact()
+                    s.reader.machine.maybe_compact(
+                        max_batches=ARRANGEMENT_COMPACTION_BATCHES(
+                            COMPUTE_CONFIGS
+                        )
+                    )
         elif kind == "UpdateConfiguration":
             # Command-stream ordering makes every worker flip the flags
-            # at the same point (compute_state.rs:46-59 analog).
+            # at the same point (compute_state.rs:46-59 analog). The
+            # process-global ConfigSet is the read site for rendering
+            # decisions (delta-join breadth, temporal filters, ...).
+            from ..utils.dyncfg import COMPUTE_CONFIGS
+
             self.config.update(cmd["params"])
+            COMPUTE_CONFIGS.update(cmd["params"])
 
     def _serve_peeks(self, conn) -> bool:
         served = False
@@ -349,17 +363,23 @@ class ReplicaWorker:
 
     def _report_frontiers(self, conn) -> bool:
         changed = {}
+        records = {}
         for name, inst in self.dataflows.items():
             upper = inst.view.upper
             if upper != inst.reported_upper:
                 changed[name] = upper
                 inst.reported_upper = upper
+                # Arrangement introspection (mz_arrangement_sizes
+                # analog): the output arrangement's current row count.
+                # One scalar device->host read, only on frontier change.
+                records[name] = int(inst.view.df.output.batch.count)
         if changed:
             ctp.send_msg(
                 conn,
                 {
                     "kind": "Frontiers",
                     "uppers": changed,
+                    "records": records,
                     "replica_id": self.replica_id,
                 },
             )

@@ -7,8 +7,10 @@ column selection (project). MFPs are pushed into sources, joins, and every
 render node; on TPU the whole MFP fuses into one XLA computation over the
 batch, ending in a scatter compaction for the filter.
 
-Temporal predicates on ``mz_now()`` (linear.rs:404-408) are not yet
-implemented (tracked for operator set v1, SURVEY.md §7 step 5).
+Temporal predicates on ``mz_now()`` (linear.rs:404-408) live in
+ops/temporal.py (TemporalFilterOp): the render layer splits them out of
+Filter nodes; plain (non-comparison) mz_now() uses evaluate here via the
+``time`` argument.
 """
 
 from __future__ import annotations
@@ -74,8 +76,9 @@ class MapFilterProject:
         return Schema(cols)
 
 
-def apply_mfp(mfp: MapFilterProject, batch: Batch) -> Batch:
-    """Evaluate the MFP over a batch: fused map+filter+project, compacted."""
+def apply_mfp(mfp: MapFilterProject, batch: Batch, time=None) -> Batch:
+    """Evaluate the MFP over a batch: fused map+filter+project, compacted.
+    ``time`` is the step timestamp for mz_now() (non-temporal uses)."""
     assert batch.schema.arity == mfp.input_arity, (
         f"mfp arity {mfp.input_arity} != batch arity {batch.schema.arity}"
     )
@@ -95,7 +98,7 @@ def apply_mfp(mfp: MapFilterProject, batch: Batch) -> Batch:
             count=batch.count,
             schema=Schema(work_schema),
         )
-        ev = eval_expr(e, tmp)
+        ev = eval_expr(e, tmp, time)
         work_cols.append(ev.values)
         work_nulls.append(ev.nulls)
         work_schema.append(ev.col)
@@ -112,7 +115,7 @@ def apply_mfp(mfp: MapFilterProject, batch: Batch) -> Batch:
     # Filter: predicate TRUE (not false, not NULL) keeps the row.
     keep = None
     for p in mfp.predicates:
-        ev = eval_expr(p, full)
+        ev = eval_expr(p, full, time)
         ok = jnp.logical_and(ev.values, jnp.logical_not(ev.null_mask()))
         keep = ok if keep is None else jnp.logical_and(keep, ok)
 

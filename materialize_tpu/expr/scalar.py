@@ -71,6 +71,31 @@ def _lift(x) -> "ScalarExpr":
 
 
 @dataclass(frozen=True)
+class MzNow(ScalarExpr):
+    """The current virtual timestamp: CallUnmaterializable::MzNow
+    (expr/src/scalar.rs). Evaluates to the step's time; predicates over
+    it become TEMPORAL FILTERS (expr/src/linear.rs:404-408) that
+    schedule future retractions/insertions."""
+
+    def typ(self, schema: Schema) -> Column:
+        return Column("mz_now", ColumnType.TIMESTAMP)
+
+
+def contains_mz_now(expr: ScalarExpr) -> bool:
+    if isinstance(expr, MzNow):
+        return True
+    for f in getattr(expr, "__dataclass_fields__", {}):
+        v = getattr(expr, f)
+        if isinstance(v, ScalarExpr) and contains_mz_now(v):
+            return True
+        if isinstance(v, tuple) and any(
+            isinstance(x, ScalarExpr) and contains_mz_now(x) for x in v
+        ):
+            return True
+    return False
+
+
+@dataclass(frozen=True)
 class ColumnRef(ScalarExpr):
     """Column reference by position (like MirScalarExpr::Column)."""
 
@@ -251,10 +276,22 @@ def _to_decimal_scale(e: Evaled, scale: int) -> jnp.ndarray:
     return v
 
 
-def eval_expr(expr: ScalarExpr, batch: Batch) -> Evaled:
-    """Recursively build the XLA computation for `expr` over `batch`."""
+def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
+    """Recursively build the XLA computation for `expr` over `batch`.
+
+    ``time`` is the step's virtual timestamp, consumed by MzNow (the
+    CallUnmaterializable mz_now() of expr/src/scalar.rs) — None outside
+    a timed step, where MzNow is an error."""
     schema = batch.schema
     cap = batch.capacity
+
+    if isinstance(expr, MzNow):
+        if time is None:
+            raise ValueError(
+                "mz_now() evaluated outside a timed dataflow step"
+            )
+        vals = jnp.full(cap, time, dtype=jnp.int64)
+        return Evaled(vals, None, expr.typ(schema))
 
     if isinstance(expr, ColumnRef):
         return Evaled(
@@ -270,7 +307,7 @@ def eval_expr(expr: ScalarExpr, batch: Batch) -> Evaled:
         return Evaled(vals, None, col)
 
     if isinstance(expr, CallUnary):
-        e = eval_expr(expr.expr, batch)
+        e = eval_expr(expr.expr, batch, time)
         col = expr.typ(schema)
         f = expr.func
         if f == UnaryFunc.NOT:
@@ -300,8 +337,8 @@ def eval_expr(expr: ScalarExpr, batch: Batch) -> Evaled:
         raise NotImplementedError(f)
 
     if isinstance(expr, CallBinary):
-        l = eval_expr(expr.left, batch)
-        r = eval_expr(expr.right, batch)
+        l = eval_expr(expr.left, batch, time)
+        r = eval_expr(expr.right, batch, time)
         col = expr.typ(schema)
         nulls = _merge_nulls(l, r)
         f = expr.func
@@ -360,7 +397,7 @@ def eval_expr(expr: ScalarExpr, batch: Batch) -> Evaled:
 
     if isinstance(expr, CallVariadic):
         col = expr.typ(schema)
-        parts = [eval_expr(e, batch) for e in expr.exprs]
+        parts = [eval_expr(e, batch, time) for e in expr.exprs]
         if expr.func == VariadicFunc.AND:
             # SQL 3VL: FALSE dominates NULL
             val = jnp.ones(cap, dtype=bool)
@@ -404,9 +441,9 @@ def eval_expr(expr: ScalarExpr, batch: Batch) -> Evaled:
         raise NotImplementedError(expr.func)
 
     if isinstance(expr, If):
-        c = eval_expr(expr.cond, batch)
-        t = eval_expr(expr.then, batch)
-        e = eval_expr(expr.els, batch)
+        c = eval_expr(expr.cond, batch, time)
+        t = eval_expr(expr.then, batch, time)
+        e = eval_expr(expr.els, batch, time)
         col = expr.typ(schema)
         cond = jnp.logical_and(c.values, jnp.logical_not(c.null_mask()))
         vals = jnp.where(cond, t.values, e.values)

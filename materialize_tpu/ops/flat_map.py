@@ -35,8 +35,8 @@ def flat_map(
     """
     if func != "generate_series":
         raise NotImplementedError(f"table function {func}")
-    start = eval_expr(exprs[0], batch)
-    stop = eval_expr(exprs[1], batch)
+    start = eval_expr(exprs[0], batch, out_time)
+    stop = eval_expr(exprs[1], batch, out_time)
     null = jnp.logical_or(start.null_mask(), stop.null_mask())
     n = jnp.clip(
         stop.values.astype(jnp.int64) - start.values.astype(jnp.int64) + 1,

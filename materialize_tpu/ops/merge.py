@@ -30,7 +30,12 @@ def merge_sorted(
     tail is dropped, count is clamped to out_capacity, and `overflowed`
     is True — the host must retry at a larger capacity tier
     (SURVEY.md §7 hard part #1)."""
-    assert a.schema.names == b.schema.names
+    # Positional type equality: column NAMES are documentation and may
+    # legitimately differ across plan paths (e.g. a Let-bound reduce
+    # named by HIR vs its MIR-lowered delta); operators are positional.
+    assert tuple(c.dtype for c in a.schema.columns) == tuple(
+        c.dtype for c in b.schema.columns
+    ), (a.schema.names, b.schema.names)
     cap_a, cap_b = a.capacity, b.capacity
     ia = jnp.arange(cap_a, dtype=jnp.int32)
     ib = jnp.arange(cap_b, dtype=jnp.int32)

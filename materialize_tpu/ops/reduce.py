@@ -84,7 +84,7 @@ def output_schema(input_schema: Schema, group_key, aggregates) -> Schema:
 
 
 def delta_contributions(
-    batch: Batch, group_key, aggregates, state_schema: Schema
+    batch: Batch, group_key, aggregates, state_schema: Schema, time=None
 ) -> Batch:
     """Map an input delta batch to accumulator-contribution rows
     (one per input row; consolidation groups them)."""
@@ -95,7 +95,7 @@ def delta_contributions(
     cols.append(diff.astype(jnp.int64))  # __rows__
     nulls.append(None)
     for agg in aggregates:
-        ev = eval_expr(agg.expr, batch)
+        ev = eval_expr(agg.expr, batch, time)
         nn = jnp.logical_not(ev.null_mask())
         nn_i = nn.astype(jnp.int64) * diff
         if agg.func is AggregateFunc.COUNT:
@@ -264,13 +264,14 @@ def minmax_state_schema(
 
 
 def minmax_contributions(
-    batch: Batch, group_key, agg: AggregateExpr, state_schema: Schema
+    batch: Batch, group_key, agg: AggregateExpr, state_schema: Schema,
+    time=None,
 ) -> Batch:
     """Project an input delta batch to (key..., value) multiset updates,
     dropping NULL values (min/max ignore them)."""
     cols = [batch.cols[i] for i in group_key]
     nulls = [batch.nulls[i] for i in group_key]
-    ev = eval_expr(agg.expr, batch)
+    ev = eval_expr(agg.expr, batch, time)
     vcol = state_schema[len(group_key)]
     cols.append(ev.values.astype(vcol.dtype))
     nulls.append(None)
@@ -375,7 +376,7 @@ class ReduceOp:
         acc_state = state[0]
         acc_aggs = tuple(a for _, a in self.acc_aggs)
         contrib = delta_contributions(
-            delta, self.group_key, acc_aggs, self.state_schema
+            delta, self.group_key, acc_aggs, self.state_schema, out_time
         )
         groups = sum_by_key(contrib, self.n_key)  # one row per touched group
         gcap = groups.capacity
@@ -403,7 +404,7 @@ class ReduceOp:
             is_max = agg.func is AggregateFunc.MAX
             mm_old.append(minmax_query(mm_state, probe_lanes, is_max))
             mm_contrib = minmax_contributions(
-                delta, self.group_key, agg, sch
+                delta, self.group_key, agg, sch, out_time
             )
             new_mm, overflow[p] = insert(
                 mm_state, mm_contrib, mm_state.capacity

@@ -1,0 +1,202 @@
+"""Temporal filters: predicates over mz_now() with scheduled futures.
+
+Analog of the reference's MfpPlan temporal predicates
+(``expr/src/linear.rs:404-408,1724``): a predicate like
+
+    mz_now() >= lo_expr AND mz_now() < hi_expr
+
+makes a row *active* during the virtual-time window [lo, hi). Instead of
+re-evaluating the filter every step, the operator emits the insertion at
+``max(lo, now)`` and schedules the retraction at ``hi`` — the update
+stream stays incremental and the dataflow does no work while nothing
+changes (the reference emits future-timestamped retractions; the TPU
+re-cast buffers them in a device-resident Arrangement keyed by release
+time and drains entries as the frontier passes: the temporal-bucketing
+idea of ``compute/src/extensions/temporal_bucket.rs`` with one bucket).
+
+Bound canonicalization (render layer):
+    mz_now() >= e  ->  lo = e            e >= mz_now()  ->  hi = e + 1
+    mz_now() >  e  ->  lo = e + 1        e >  mz_now()  ->  hi = e
+    mz_now() <= e  ->  hi = e + 1        e <= mz_now()  ->  lo = e
+    mz_now() <  e  ->  hi = e            e <  mz_now()  ->  lo = e + 1
+A NULL bound means the predicate is unknown: the row is never active.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..arrangement.spine import Arrangement
+from ..expr.scalar import ScalarExpr, eval_expr
+from ..ops.consolidate import consolidate
+from ..ops.sort import compact, concat_batches, shrink
+from ..repr.batch import Batch
+from ..repr.schema import Schema
+
+# Far-future sentinel: "no upper bound" (u64 max would overflow the +1
+# canonicalization; this leaves headroom while beyond any real time).
+NO_UPPER = np.uint64(1 << 62)
+
+
+def canonicalize_temporal(predicates) -> tuple[list, list]:
+    """Split temporal predicates into (lo_exprs, hi_exprs) per the table
+    in the module docstring. Only comparisons with a BARE mz_now() on
+    one side are supported (the reference normalizes to this shape)."""
+    from ..expr.scalar import BinaryFunc, CallBinary, MzNow, contains_mz_now
+
+    lo: list = []
+    hi: list = []
+    for p in predicates:
+        if not isinstance(p, CallBinary):
+            raise NotImplementedError(
+                f"unsupported temporal predicate {p!r}: mz_now() must "
+                "appear in a plain comparison"
+            )
+        l, r, f = p.left, p.right, p.func
+        if isinstance(l, MzNow) and not contains_mz_now(r):
+            e = r
+            if f == BinaryFunc.GTE:
+                lo.append(e)
+            elif f == BinaryFunc.GT:
+                lo.append(e + 1)
+            elif f == BinaryFunc.LTE:
+                hi.append(e + 1)
+            elif f == BinaryFunc.LT:
+                hi.append(e)
+            else:
+                raise NotImplementedError(
+                    f"temporal comparison {f!r} (use <,<=,>,>=)"
+                )
+        elif isinstance(r, MzNow) and not contains_mz_now(l):
+            e = l
+            if f == BinaryFunc.GTE:  # e >= now
+                hi.append(e + 1)
+            elif f == BinaryFunc.GT:  # e > now
+                hi.append(e)
+            elif f == BinaryFunc.LTE:  # e <= now
+                lo.append(e)
+            elif f == BinaryFunc.LT:  # e < now
+                lo.append(e + 1)
+            else:
+                raise NotImplementedError(
+                    f"temporal comparison {f!r} (use <,<=,>,>=)"
+                )
+        else:
+            raise NotImplementedError(
+                "temporal predicate needs a bare mz_now() on one side"
+            )
+    return lo, hi
+
+
+@dataclass
+class TemporalFilterOp:
+    """State: one Arrangement of scheduled future updates, keyed by all
+    columns (the time column holds each update's release time). Per
+    step: compute each input row's window, emit what is already active,
+    buffer the future insertions/retractions, and drain everything whose
+    release time has arrived. n_parts = 1."""
+
+    schema: Schema
+    lo_exprs: tuple  # ScalarExpr lower bounds (max wins)
+    hi_exprs: tuple  # ScalarExpr EXCLUSIVE upper bounds (min wins)
+
+    def __post_init__(self):
+        self.out_schema = self.schema
+        self.key = tuple(range(self.schema.arity))
+        self.n_parts = 1
+
+    def init_state(self, capacity: int = 256) -> tuple:
+        return (Arrangement.empty(self.schema, self.key, capacity),)
+
+    def _bounds(self, batch: Batch, time):
+        """Per-row (lo, hi, defined) as int64 virtual times."""
+        cap = batch.capacity
+        lo = jnp.zeros(cap, jnp.int64)
+        defined = jnp.ones(cap, bool)
+        for e in self.lo_exprs:
+            ev = eval_expr(e, batch, time)
+            defined = jnp.logical_and(
+                defined, jnp.logical_not(ev.null_mask())
+            )
+            lo = jnp.maximum(lo, ev.values.astype(jnp.int64))
+        hi = jnp.full(cap, NO_UPPER.astype(np.int64), jnp.int64)
+        for e in self.hi_exprs:
+            ev = eval_expr(e, batch, time)
+            defined = jnp.logical_and(
+                defined, jnp.logical_not(ev.null_mask())
+            )
+            hi = jnp.minimum(hi, ev.values.astype(jnp.int64))
+        return lo, hi, defined
+
+    def step(self, state: tuple, delta: Batch, out_time, out_cap=None):
+        """Returns (new_state, out_delta, state_overflow: dict
+        part->flag, out_overflow). ``out_cap`` is the output capacity
+        tier (host-grown on out_overflow; growing the buffer cannot fix
+        an output overflow, so the flags are separate)."""
+        out_cap = out_cap if out_cap is not None else delta.capacity
+        (buf,) = state
+        t = jnp.asarray(out_time).astype(jnp.int64)
+        lo, hi, defined = self._bounds(delta, out_time)
+        valid = jnp.logical_and(delta.valid_mask(), defined)
+        nonempty = jnp.logical_and(valid, lo < hi)
+
+        # Active now: lo <= t < hi -> emit at t.
+        active = jnp.logical_and(
+            nonempty, jnp.logical_and(lo <= t, t < hi)
+        )
+        now_out = compact(
+            delta.replace(
+                time=jnp.full(delta.capacity, out_time, jnp.uint64)
+            ),
+            active,
+        )
+
+        # Future insertion: lo > t -> schedule +d at lo.
+        fut_ins = compact(
+            delta.replace(time=lo.astype(jnp.uint64)),
+            jnp.logical_and(nonempty, lo > t),
+        )
+        # Future retraction: hi > t and bounded -> schedule -d at hi
+        # (rows already dead, hi <= t, contribute nothing).
+        fut_ret = compact(
+            delta.replace(
+                time=hi.astype(jnp.uint64), diff=-delta.diff
+            ),
+            jnp.logical_and(
+                nonempty,
+                jnp.logical_and(hi > t, hi < NO_UPPER.astype(np.int64)),
+            ),
+        )
+
+        # Merge into the buffer, consolidating WITH the time column:
+        # distinct release times must stay separate (spine.insert's
+        # timeless consolidation would merge them), while an insert and
+        # its own retraction scheduled for the same release time cancel.
+        merged = consolidate(
+            concat_batches([buf.batch, fut_ins, fut_ret]),
+            include_time=True,
+        )
+        merged, ovf1 = shrink(merged, buf.capacity)
+
+        # Drain: scheduled updates whose release time has arrived.
+        due = jnp.logical_and(
+            merged.valid_mask(), merged.time.astype(jnp.int64) <= t
+        )
+        due_out = compact(
+            merged.replace(
+                time=jnp.full(merged.capacity, out_time, jnp.uint64)
+            ),
+            due,
+        )
+        kept = compact(
+            merged,
+            jnp.logical_and(merged.valid_mask(), jnp.logical_not(due)),
+        )
+        new_buf = Arrangement(kept, self.key)
+
+        out = concat_batches([now_out, due_out])
+        out, ovf2 = shrink(out, out_cap)
+        return (new_buf,), out, {0: ovf1}, ovf2

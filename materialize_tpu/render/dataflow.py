@@ -44,6 +44,7 @@ from ..ops.delta_join import DeltaJoinOp
 from ..ops.flat_map import flat_map
 from ..ops.join import JoinOp
 from ..ops.reduce import ReduceOp
+from ..ops.temporal import TemporalFilterOp, canonicalize_temporal
 from ..ops.threshold import ThresholdOp
 from ..ops.topk import TopKOp
 from ..ops.sort import concat_batches, shrink
@@ -87,6 +88,12 @@ class _RenderContext:
         # arrangement insert, so the insert's sorts compile at a small
         # capacity regardless of input batch size.
         self.out_delta_cap = 4096
+        # The dataflow's first processed timestamp (its as_of): set by
+        # the host wrapper before the first step, read at trace time.
+        # Constants emit exactly once, AT this time (render.rs:1170
+        # "rows advanced to as_of") — not at literal time 0, which a
+        # hydrated dataflow never processes.
+        self.first_time = 0
 
     @property
     def sharded(self) -> bool:
@@ -142,8 +149,8 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
         rows = expr.rows
 
         def run(states, inputs, time):
-            # Emit the constant collection exactly once: at time == 0
-            # (the as_of), nothing afterwards (render.rs:1170-1212).
+            # Emit the constant collection exactly once: at the
+            # dataflow's as_of, nothing afterwards (render.rs:1170-1212).
             n = len(rows)
             cap = capacity_tier(max(n, 1))
             cols = []
@@ -156,7 +163,7 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
                 cols.append(jnp.asarray(pad))
             diffs = np.zeros(cap, dtype=DIFF_DTYPE)
             diffs[:n] = [r[1] for r in rows]
-            first = (time == 0).astype(jnp.int32)
+            first = (time == ctx.first_time).astype(jnp.int32)
             if ctx.sharded:
                 # Exactly one worker emits the constant; the exchange in
                 # front of any stateful consumer routes rows to owners.
@@ -186,7 +193,7 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
 
         def run(states, inputs, time):
             b, upd, ovf = inner(states, inputs, time)
-            return apply_mfp(mfp, b), upd, ovf
+            return apply_mfp(mfp, b, time), upd, ovf
 
         return run
 
@@ -195,22 +202,68 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
         mfp = MapFilterProject(
             expr.input.schema().arity, expressions=expr.scalars
         )
+        out_schema = expr.schema()  # MIR's naming (c{i}) is authoritative
 
         def run(states, inputs, time):
             b, upd, ovf = inner(states, inputs, time)
-            return apply_mfp(mfp, b), upd, ovf
+            return (
+                apply_mfp(mfp, b, time).replace(schema=out_schema),
+                upd,
+                ovf,
+            )
 
         return run
 
     if isinstance(expr, mir.Filter):
+        from ..expr.scalar import contains_mz_now
+
+        temporal = [p for p in expr.predicates if contains_mz_now(p)]
+        plain = [p for p in expr.predicates if not contains_mz_now(p)]
         inner = _build(expr.input, ctx)
         mfp = MapFilterProject(
-            expr.input.schema().arity, predicates=expr.predicates
+            expr.input.schema().arity, predicates=plain
         )
+        if not temporal:
+
+            def run(states, inputs, time):
+                b, upd, ovf = inner(states, inputs, time)
+                return apply_mfp(mfp, b, time), upd, ovf
+
+            return run
+
+        # Temporal predicates: plain filter first, then the scheduled
+        # window operator (expr/src/linear.rs:1724 MfpPlan). No exchange:
+        # each worker schedules its own rows' futures.
+        from ..utils.dyncfg import (
+            COMPUTE_CONFIGS,
+            ENABLE_TEMPORAL_FILTERS,
+        )
+
+        if not ENABLE_TEMPORAL_FILTERS(COMPUTE_CONFIGS):
+            raise NotImplementedError(
+                "temporal filters disabled by dyncfg "
+                "enable_temporal_filters"
+            )
+        lo_exprs, hi_exprs = canonicalize_temporal(temporal)
+        op = TemporalFilterOp(
+            expr.input.schema(), tuple(lo_exprs), tuple(hi_exprs)
+        )
+        slot = ctx.new_slot(op, op.init_state())
+        osite = ctx.new_join_site()  # output-capacity tier
 
         def run(states, inputs, time):
             b, upd, ovf = inner(states, inputs, time)
-            return apply_mfp(mfp, b), upd, ovf
+            b = apply_mfp(mfp, b, time)
+            new_state, out, overflow, out_ovf = op.step(
+                states[slot], b, time, ctx.join_caps[osite]
+            )
+            upd = dict(upd)
+            upd[slot] = new_state
+            ovf = dict(ovf)
+            for part, flag in overflow.items():
+                ovf[("state", slot, part)] = flag
+            ovf[("join", osite)] = out_ovf
+            return out, upd, ovf
 
         return run
 
@@ -386,9 +439,15 @@ def _join_stage_keys(expr: mir.Join, offsets: list, stage: int):
 
 
 def _build_join(expr: mir.Join, ctx: _RenderContext):
+    from ..utils.dyncfg import COMPUTE_CONFIGS, DELTA_JOIN_MIN_INPUTS
+
     impl = expr.implementation
     if impl == "auto":
-        impl = "delta" if len(expr.inputs) >= 3 else "linear"
+        impl = (
+            "delta"
+            if len(expr.inputs) >= DELTA_JOIN_MIN_INPUTS(COMPUTE_CONFIGS)
+            else "linear"
+        )
     if impl == "delta":
         return _build_join_delta(expr, ctx)
     return _build_join_linear(expr, ctx)
@@ -729,6 +788,11 @@ class _DataflowBase:
         rolled back (states are immutable device values), tiers grown,
         and the span replayed — steps are pure, so the replay is
         idempotent. This keeps the hot loop free of per-step syncs."""
+        if getattr(self, "_first_time", None) is None:
+            # The dataflow's as_of: the first processed timestamp
+            # (constants fire exactly here; baked at trace time).
+            self._first_time = int(self.time)
+            self._ctx.first_time = self._first_time
         packed = [self._pack_inputs(i) for i in inputs_list]
         while True:
             ck = (list(self.states), self.output, self.time)
@@ -798,6 +862,9 @@ class Dataflow(_DataflowBase):
         new_states = list(states)
         for k, v in upd.items():
             new_states[k] = v
+        # The delta is what sinks/subscribers see: consolidate so
+        # union-produced +/- pairs at the same time cancel.
+        out = consolidate(out, include_time=True)
         out, shrink_ovf = shrink(out, self._ctx.out_delta_cap)
         new_output, out_ovf = insert(
             output, out, out_capacity=output.capacity
@@ -961,6 +1028,7 @@ class ShardedDataflow(_DataflowBase):
             new_states = list(states)
             for k, v in upd.items():
                 new_states[k] = v
+            out = consolidate(out, include_time=True)
             out, shrink_ovf = shrink(out, self._ctx.out_delta_cap)
             new_output, out_ovf = insert(
                 output, out, out_capacity=output.capacity

@@ -51,6 +51,11 @@ class HLiteral(HirScalar):
 
 
 @dataclass(frozen=True)
+class HMzNow(HirScalar):
+    """mz_now(): the current virtual timestamp (temporal filters)."""
+
+
+@dataclass(frozen=True)
 class HCallUnary(HirScalar):
     func: str
     expr: HirScalar
@@ -359,6 +364,8 @@ def _to_mir_shape(e: HirScalar):
     unsupported here; lowering replaces them with columns first)."""
     if isinstance(e, HColumn):
         return mscalar.ColumnRef(e.index)
+    if isinstance(e, HMzNow):
+        return mscalar.MzNow()
     if isinstance(e, HLiteral):
         return mscalar.Literal(e.value, e.ctype, e.scale)
     if isinstance(e, HCallUnary):

@@ -130,6 +130,8 @@ def _lower_scalars(cur: mir.RelationExpr, exprs):
             return ms.ColumnRef(idx)
         if isinstance(e, h.HColumn):
             return ms.ColumnRef(e.index)
+        if isinstance(e, h.HMzNow):
+            return ms.MzNow()
         if isinstance(e, h.HLiteral):
             return ms.Literal(e.value, e.ctype, e.scale)
         if isinstance(e, h.HCallUnary):

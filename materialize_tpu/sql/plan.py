@@ -180,6 +180,11 @@ def _plan_insert(stmt: ast.Insert, catalog: CatalogInterface) -> Plan:
     schema = catalog.resolve_item(stmt.table)
     names = list(schema.names)
     if stmt.columns:
+        if len(set(stmt.columns)) != len(stmt.columns):
+            raise PlanError(
+                f"column specified more than once in INSERT: "
+                f"{list(stmt.columns)}"
+            )
         order = []
         for c in stmt.columns:
             if c not in names:

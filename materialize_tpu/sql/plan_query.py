@@ -34,6 +34,7 @@ from .hir import (
     HLetRec,
     HLiteral,
     HMap,
+    HNegate,
     HProject,
     HReduce,
     HRename,
@@ -424,13 +425,55 @@ class QueryPlanner:
         having = None
         if sel.having is not None:
             having_ast = rewrite(sel.having)
-            rel2 = HReduce(rel, tuple(key_indices), tuple(aggs))
+            rel2 = self._reduce_with_defaults(rel, key_indices, aggs)
             post_scope = self._post_agg_scope(scope, key_indices, aggs)
             having = self.plan_expr(having_ast, post_scope)
             return rel2, post_scope, new_items, having
-        rel2 = HReduce(rel, tuple(key_indices), tuple(aggs))
+        rel2 = self._reduce_with_defaults(rel, key_indices, aggs)
         post_scope = self._post_agg_scope(scope, key_indices, aggs)
         return rel2, post_scope, new_items, None
+
+    def _reduce_with_defaults(self, rel, key_indices, aggs):
+        """HReduce, plus — for GLOBAL aggregates (no group key) — the SQL
+        default row over empty input (COUNT -> 0, others NULL): the
+        reference's lowering emits reduce ∪ (defaults ∖ nonempty-flag)
+        (sql/src/plan/lowering.rs reduce defaults)."""
+        red = HReduce(rel, tuple(key_indices), tuple(aggs))
+        if key_indices:
+            return red
+        # Let-bind the reduce: it appears twice in the union (directly
+        # and inside the nonempty flag) and must be computed ONCE (the
+        # render layer shares Let bindings; without it the whole
+        # upstream pipeline would be maintained twice).
+        self._defaults_seq = getattr(self, "_defaults_seq", 0) + 1
+        bind = f"__agg{self._defaults_seq}"
+        red_get = HGet(bind, red.schema())
+        flag_col = Column("f", ColumnType.INT64)
+        flag_schema = Schema([flag_col])
+        # One (1,) row iff the reduce output is nonempty.
+        has = HProject(
+            HMap(red_get, ((HLiteral(1, ColumnType.INT64), flag_col),)),
+            (len(aggs),),
+        )
+        miss = HUnion(
+            (
+                HConstant((((1,), 1),), flag_schema),
+                HNegate(has),
+            )
+        )
+        defaults = []
+        for a in aggs:
+            if a.func is AggregateFunc.COUNT:
+                defaults.append((HLiteral(0, ColumnType.INT64), a.out))
+            else:
+                defaults.append(
+                    (HLiteral(None, a.out.ctype, a.out.scale), a.out)
+                )
+        deflt = HProject(
+            HMap(miss, tuple(defaults)),
+            tuple(range(1, len(aggs) + 1)),
+        )
+        return HLet(bind, red, HUnion((red_get, deflt)))
 
     def _post_agg_scope(self, scope, key_indices, aggs):
         items = [
@@ -569,6 +612,12 @@ class QueryPlanner:
                 return HCallUnary(
                     UnaryFunc.ABS, self.plan_expr(e.args[0], scope)
                 )
+            if e.name == "mz_now":
+                if e.args:
+                    raise PlanError("mz_now() takes no arguments")
+                from .hir import HMzNow
+
+                return HMzNow()
             raise PlanError(f"unknown function {e.name}")
         if isinstance(e, ast.Exists):
             rel, _ = self.plan_query(e.query)

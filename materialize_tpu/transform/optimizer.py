@@ -108,7 +108,7 @@ def _shift_scalar(e: ms.ScalarExpr, mapping: dict) -> ms.ScalarExpr | None:
         if e.index not in mapping:
             return None
         return ms.ColumnRef(mapping[e.index])
-    if isinstance(e, ms.Literal):
+    if isinstance(e, (ms.Literal, ms.MzNow)):
         return e
     if isinstance(e, ms.CallUnary):
         inner = _shift_scalar(e.expr, mapping)
@@ -212,7 +212,10 @@ def predicate_pushdown(expr: mir.RelationExpr) -> mir.RelationExpr:
                     shifted = _shift_scalar(
                         p, {r: r - offsets[k] for r in refs}
                     )
-                    per_input[k].append(shifted)
+                    if shifted is None:
+                        kept.append(p)  # unpushable: keep at the join
+                    else:
+                        per_input[k].append(shifted)
                 else:
                     kept.append(p)
             if any(per_input):

@@ -1,0 +1,99 @@
+"""dyncfg: typed dynamic configuration flags.
+
+Analog of the reference's ``mz_dyncfg`` (``dyncfg/src/lib.rs:10-30``):
+typed ``Config``s registered into a shared ``ConfigSet``; values can be
+updated at runtime (from a file, SQL, or the controller) and every
+component reads the current value at use sites. Updates propagate to
+replicas IN COMMAND-STREAM ORDER via ``UpdateConfiguration`` (see
+coord/protocol.py), so all workers flip a flag at the same point in the
+update stream (compute_state.rs:46-59 discipline).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass
+class Config:
+    """One typed flag: name, default, help. Bind into a ConfigSet to
+    read values."""
+
+    name: str
+    default: Any
+    help: str = ""
+
+    def __call__(self, config_set: "ConfigSet"):
+        return config_set.get(self.name)
+
+    def register(self, config_set: "ConfigSet") -> "Config":
+        config_set.add(self)
+        return self
+
+
+class ConfigSet:
+    def __init__(self):
+        self._configs: dict[str, Config] = {}
+        self._values: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def add(self, cfg: Config) -> None:
+        with self._lock:
+            existing = self._configs.get(cfg.name)
+            if existing is not None and existing.default != cfg.default:
+                raise ValueError(
+                    f"config {cfg.name!r} re-registered with a "
+                    "different default"
+                )
+            self._configs[cfg.name] = cfg
+
+    def get(self, name: str):
+        with self._lock:
+            if name in self._values:
+                return self._values[name]
+            return self._configs[name].default
+
+    def update(self, values: dict) -> dict:
+        """Apply updates (unknown keys are kept — a newer process may
+        know them); returns the full current value map for shipping to
+        replicas."""
+        with self._lock:
+            for k, v in values.items():
+                cfg = self._configs.get(k)
+                if cfg is not None and v is not None:
+                    # Coerce to the default's type (flags arrive as
+                    # strings from SQL/files).
+                    t = type(cfg.default)
+                    if t is bool and isinstance(v, str):
+                        v = v.lower() in ("true", "on", "1", "yes")
+                    elif not isinstance(v, t):
+                        v = t(v)
+                self._values[k] = v
+            return dict(self._values)
+
+    def current(self) -> dict:
+        with self._lock:
+            out = {n: c.default for n, c in self._configs.items()}
+            out.update(self._values)
+            return out
+
+
+# The compute-layer flag set (compute-types/src/dyncfgs.rs analog).
+COMPUTE_CONFIGS = ConfigSet()
+
+ENABLE_TEMPORAL_FILTERS = Config(
+    "enable_temporal_filters", True,
+    "render mz_now() predicates as scheduled temporal filters",
+).register(COMPUTE_CONFIGS)
+
+DELTA_JOIN_MIN_INPUTS = Config(
+    "delta_join_min_inputs", 3,
+    "minimum join breadth for the delta-join plan (vs linear)",
+).register(COMPUTE_CONFIGS)
+
+ARRANGEMENT_COMPACTION_BATCHES = Config(
+    "arrangement_compaction_batches", 8,
+    "shard spine length that triggers background compaction",
+).register(COMPUTE_CONFIGS)

@@ -1,0 +1,162 @@
+"""Metrics: Prometheus-style registry.
+
+Analog of the reference's ``ore::metrics::MetricsRegistry`` (every
+process registers counters/gauges/histograms and serves them in the
+Prometheus text exposition format; SURVEY.md §5 metrics/observability).
+No external client library — the text format is trivial and this keeps
+the zero-dependency rule.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, registry: "MetricsRegistry"):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        if registry is not None:
+            registry._register(self)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_="", registry=None):
+        super().__init__(name, help_, registry)
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def samples(self):
+        return [(self.name, {}, self._value)]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_="", registry=None):
+        super().__init__(name, help_, registry)
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def samples(self):
+        return [(self.name, {}, self._value)]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    DEFAULT_BUCKETS = (
+        0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10,
+    )
+
+    def __init__(self, name, help_="", buckets=None, registry=None):
+        super().__init__(name, help_, registry)
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._counts[bisect_right(self.buckets, v)] += 1
+            self._sum += v
+            self._total += 1
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket counts (upper bound)."""
+        with self._lock:
+            if self._total == 0:
+                return 0.0
+            target = q * self._total
+            acc = 0
+            for i, c in enumerate(self._counts[:-1]):
+                acc += c
+                if acc >= target:
+                    return self.buckets[i]
+            return float("inf")
+
+    def samples(self):
+        out = []
+        acc = 0
+        for b, c in zip(self.buckets, self._counts):
+            acc += c
+            out.append((self.name + "_bucket", {"le": str(b)}, acc))
+        out.append(
+            (self.name + "_bucket", {"le": "+Inf"}, acc + self._counts[-1])
+        )
+        out.append((self.name + "_sum", {}, self._sum))
+        out.append((self.name + "_count", {}, self._total))
+        return out
+
+
+class MetricsRegistry:
+    """Register-and-scrape: the per-process metrics authority."""
+
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, m: _Metric) -> None:
+        with self._lock:
+            if m.name in self._metrics:
+                raise ValueError(f"metric {m.name!r} already registered")
+            self._metrics[m.name] = m
+
+    def counter(self, name, help_="") -> Counter:
+        return Counter(name, help_, registry=self)
+
+    def gauge(self, name, help_="") -> Gauge:
+        return Gauge(name, help_, registry=self)
+
+    def histogram(self, name, help_="", buckets=None) -> Histogram:
+        return Histogram(name, help_, buckets=buckets, registry=self)
+
+    def get(self, name: str) -> _Metric | None:
+        return self._metrics.get(name)
+
+    def expose_text(self) -> str:
+        """Prometheus text exposition format."""
+        lines = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for name, labels, value in m.samples():
+                if labels:
+                    lbl = ",".join(
+                        f'{k}="{v}"' for k, v in sorted(labels.items())
+                    )
+                    lines.append(f"{name}{{{lbl}}} {value}")
+                else:
+                    lines.append(f"{name} {value}")
+        return "\n".join(lines) + "\n"
+
+
+# Per-process default registry (ore::metrics global analog).
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,103 @@
+"""Tracing: lightweight spans with a per-process ring buffer.
+
+Analog of the reference's tracing stack (tracing + OpenTelemetry with
+runtime-settable filters, SURVEY.md §5): spans record (name, start,
+duration, attributes, parent) into a bounded ring buffer queryable as an
+introspection relation; a dynamic level filter mirrors the ``log_filter``
+system var. Span context propagates across the control protocol by
+carrying the span id in command payloads (OpenTelemetryContext riding
+PeekResponse in the reference).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time as _time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+LEVELS = {"off": 0, "error": 1, "info": 2, "debug": 3}
+
+
+@dataclass
+class SpanRecord:
+    span_id: int
+    parent_id: int | None
+    name: str
+    start: float
+    duration: float
+    level: str
+    attrs: dict = field(default_factory=dict)
+
+
+class Tracer:
+    def __init__(self, capacity: int = 4096):
+        self._buf: deque[SpanRecord] = deque(maxlen=capacity)
+        self._ids = itertools.count(1)
+        self._level = LEVELS["info"]
+        self._local = threading.local()
+        self._lock = threading.Lock()
+
+    # -- dynamic filter (log_filter system var analog) ----------------------
+    def set_level(self, level: str) -> None:
+        self._level = LEVELS[level]
+
+    @property
+    def level(self) -> str:
+        for k, v in LEVELS.items():
+            if v == self._level:
+                return k
+        return "info"
+
+    # -- span API ------------------------------------------------------------
+    @contextmanager
+    def span(self, name: str, level: str = "info", **attrs):
+        if LEVELS[level] > self._level:
+            yield None
+            return
+        span_id = next(self._ids)
+        parent = getattr(self._local, "current", None)
+        self._local.current = span_id
+        start = _time.perf_counter()
+        wall = _time.time()
+        try:
+            yield span_id
+        finally:
+            dur = _time.perf_counter() - start
+            self._local.current = parent
+            with self._lock:
+                self._buf.append(
+                    SpanRecord(
+                        span_id, parent, name, wall, dur, level, attrs
+                    )
+                )
+
+    def current_span(self) -> int | None:
+        """For protocol propagation: ship this with commands."""
+        return getattr(self._local, "current", None)
+
+    @contextmanager
+    def remote_parent(self, parent_id: int | None):
+        """Adopt a propagated remote span as the parent."""
+        saved = getattr(self._local, "current", None)
+        self._local.current = parent_id
+        try:
+            yield
+        finally:
+            self._local.current = saved
+
+    # -- introspection --------------------------------------------------------
+    def records(self, name_prefix: str = "") -> list[SpanRecord]:
+        with self._lock:
+            return [
+                r for r in self._buf if r.name.startswith(name_prefix)
+            ]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+
+TRACER = Tracer()
