@@ -154,6 +154,8 @@ class ComputeController:
         with self._lock:
             for per_df in self.frontiers.values():
                 per_df.pop(name, None)
+            for per_df in self.arrangement_records.values():
+                per_df.pop(name, None)
 
     def _history_snapshot(self):
         with self._lock:

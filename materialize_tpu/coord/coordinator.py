@@ -50,6 +50,10 @@ from .oracle import TimestampOracle
 from .protocol import DataflowDescription
 from .sources import GeneratorSource
 
+# Peeks wait for dataflow frontiers; first-compile latency on a fresh
+# replica can be tens of seconds (XLA), so the bound is generous.
+PEEK_TIMEOUT = 180.0
+
 CATALOG_SHARD = "mz_catalog"
 CATALOG_SCHEMA = Schema([Column("item", ColumnType.STRING)])
 
@@ -64,6 +68,8 @@ class ExecuteResult:
     columns: tuple = ()
     text: str = ""
     subscription: object = None
+    schema: object = None  # result Schema (wire type OIDs)
+    affected: int = 0  # DML row count (wire CommandComplete tag)
 
 
 class Coordinator:
@@ -107,6 +113,25 @@ class Coordinator:
                 CatalogItem(name=name, kind="introspection", schema=schema)
             )
         self._bootstrap()
+
+    def _unlocked(self):
+        """Release the sequencing lock around a blocking wait (peek
+        response): one cold replica compile must not block every other
+        session's statements. The catalog is not read after release, so
+        sequencing decisions stay consistent."""
+        import contextlib
+
+        coord = self
+
+        @contextlib.contextmanager
+        def cm():
+            coord._lock.release()
+            try:
+                yield
+            finally:
+                coord._lock.acquire()
+
+        return cm()
 
     # -- replicas -----------------------------------------------------------
     def add_replica(self, name: str, addr) -> None:
@@ -343,7 +368,7 @@ class Coordinator:
                     ts + 1,
                 )
         self.oracle.apply_write(ts)
-        return ExecuteResult("ok")
+        return ExecuteResult("ok", affected=len(plan.rows))
 
     # -- subscribe ------------------------------------------------------------
     def _sequence_subscribe(self, plan: SubscribePlan) -> ExecuteResult:
@@ -675,7 +700,8 @@ class Coordinator:
         df.step({})
         rows = _decode_peek_rows(df.output.batch)
         return ExecuteResult(
-            "rows", rows=_finish(rows), columns=plan.column_names
+            "rows", rows=_finish(rows), columns=plan.column_names,
+            schema=expr.schema(),
         )
 
     def _sequence_peek(self, plan: SelectPlan) -> ExecuteResult:
@@ -694,9 +720,13 @@ class Coordinator:
             as_of = self._select_timestamp_shards(
                 self._df_upstream.get(df, [])
             )
-            rows, _ = self.controller.peek(df, as_of=as_of)
+            with self._unlocked():
+                rows, _ = self.controller.peek(
+                    df, as_of=as_of, timeout=PEEK_TIMEOUT
+                )
             return ExecuteResult(
-                "rows", rows=_finish(rows), columns=plan.column_names
+                "rows", rows=_finish(rows), columns=plan.column_names,
+                schema=expr.schema(),
             )
         # Slow path: transient dataflow, peek, drop (life-of-a-query
         # slow path).
@@ -715,12 +745,16 @@ class Coordinator:
             as_of = self._select_timestamp_shards(
                 self._df_upstream.get(name, [])
             )
-            rows, _ = self.controller.peek(name, as_of=as_of)
+            with self._unlocked():
+                rows, _ = self.controller.peek(
+                    name, as_of=as_of, timeout=PEEK_TIMEOUT
+                )
         finally:
             self.controller.drop_dataflow(name)
             self._df_upstream.pop(name, None)
         return ExecuteResult(
-            "rows", rows=_finish(rows), columns=plan.column_names
+            "rows", rows=_finish(rows), columns=plan.column_names,
+            schema=expr.schema(),
         )
 
     def _register_dataflow(self, desc: DataflowDescription) -> None:

@@ -116,9 +116,9 @@ def snapshot(coord, name: str) -> list[tuple]:
         from ..utils.metrics import REGISTRY
 
         rows = []
-        for m in sorted(
-            REGISTRY._metrics.values(), key=lambda m: m.name
-        ):
+        with REGISTRY._lock:  # copy: registration may race iteration
+            metrics = list(REGISTRY._metrics.values())
+        for m in sorted(metrics, key=lambda m: m.name):
             for sname, labels, value in m.samples():
                 full = sname + (
                     "{" + ",".join(

@@ -1,0 +1,2 @@
+"""Server front end: pgwire protocol + HTTP endpoints + environmentd
+(SURVEY.md L0: src/pgwire, environmentd/src/http, server-core)."""

@@ -1,0 +1,163 @@
+"""environmentd: the controller process.
+
+Analog of the reference's ``environmentd`` (``Listeners::serve``,
+``environmentd/src/lib.rs:361``): opens the durable catalog, boots the
+coordinator + controllers, (optionally) spawns replica subprocesses, and
+serves pgwire + HTTP. One command brings up a working deployment:
+
+    python -m materialize_tpu.server.environmentd \
+        --data-dir DIR [--pg-port P] [--http-port P] [--replicas N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import atexit
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time as _time
+
+from ..coord.coordinator import Coordinator
+from ..storage.persist import FileBlob, PersistClient, SqliteConsensus
+from .http import HttpServer
+from .pgwire import PgServer
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def spawn_replica(data_dir: str, port: int, rid: str) -> subprocess.Popen:
+    """One clusterd subprocess (orchestrator-process analog)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", ""))
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "materialize_tpu.coord.replica",
+            "--port", str(port),
+            "--blob", os.path.join(data_dir, "blob"),
+            "--consensus", os.path.join(data_dir, "consensus.db"),
+            "--replica-id", rid,
+        ],
+        env=env,
+    )
+
+
+class Environment:
+    """A running deployment: coordinator + replicas + listeners."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        pg_port: int = 0,
+        http_port: int = 0,
+        n_replicas: int = 1,
+        tick_interval: float | None = 0.05,
+        in_process_replicas: bool = False,
+    ):
+        os.makedirs(data_dir, exist_ok=True)
+        self.data_dir = data_dir
+        self.procs: list[subprocess.Popen] = []
+        self._threads = []
+        replica_ports = []
+        for i in range(n_replicas):
+            port = _free_port()
+            rid = f"r{i}"
+            if in_process_replicas:
+                import threading
+
+                from ..coord.protocol import PersistLocation
+                from ..coord.replica import serve_forever
+
+                ready = threading.Event()
+                t = threading.Thread(
+                    target=serve_forever,
+                    args=(
+                        port,
+                        PersistLocation(
+                            os.path.join(data_dir, "blob"),
+                            os.path.join(data_dir, "consensus.db"),
+                        ),
+                        rid,
+                        ready,
+                    ),
+                    daemon=True,
+                )
+                t.start()
+                ready.wait(10)
+                self._threads.append(t)
+            else:
+                self.procs.append(spawn_replica(data_dir, port, rid))
+            replica_ports.append((rid, port))
+        self.coord = Coordinator(
+            PersistClient(
+                FileBlob(os.path.join(data_dir, "blob")),
+                SqliteConsensus(os.path.join(data_dir, "consensus.db")),
+            ),
+            tick_interval=tick_interval,
+        )
+        for rid, port in replica_ports:
+            self.coord.add_replica(rid, ("127.0.0.1", port))
+        self.pg = PgServer(self.coord, port=pg_port).start()
+        self.http = HttpServer(self.coord, port=http_port).start()
+
+    def shutdown(self) -> None:
+        self.pg.stop()
+        self.http.stop()
+        self.coord.shutdown()
+        for p in self.procs:
+            p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def main() -> None:
+    # The axon TPU plugin ignores the JAX_PLATFORMS env var; honor it
+    # via the config knob before any backend init (same as replica.py).
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    ap = argparse.ArgumentParser(description="materialize_tpu environmentd")
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--pg-port", type=int, default=6875)
+    ap.add_argument("--http-port", type=int, default=6876)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument(
+        "--tick-interval", type=float, default=0.05,
+        help="load-generator tick seconds",
+    )
+    args = ap.parse_args()
+    env = Environment(
+        args.data_dir,
+        pg_port=args.pg_port,
+        http_port=args.http_port,
+        n_replicas=args.replicas,
+        tick_interval=args.tick_interval,
+    )
+    atexit.register(env.shutdown)
+    print(
+        f"materialize_tpu listening: pgwire=127.0.0.1:{env.pg.port} "
+        f"http=127.0.0.1:{env.http.port} data={args.data_dir}",
+        flush=True,
+    )
+    try:
+        signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        while True:
+            _time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""HTTP endpoints: SQL-over-HTTP, metrics, readiness.
+
+Analog of the reference's ``environmentd/src/http``: POST /api/sql
+executes statements and returns JSON results; GET /metrics serves the
+Prometheus registry; GET /api/readyz for probes. Stdlib http.server —
+the control plane is not a throughput surface.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.metrics import REGISTRY
+
+
+def make_handler(coordinator):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _reply(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._reply(
+                    200, REGISTRY.expose_text().encode(),
+                    "text/plain; version=0.0.4",
+                )
+            elif self.path in ("/api/readyz", "/api/livez"):
+                self._reply(200, b"ready\n", "text/plain")
+            else:
+                self._reply(404, b"not found\n", "text/plain")
+
+        def do_POST(self):
+            if self.path != "/api/sql":
+                self._reply(404, b"not found\n", "text/plain")
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                req = json.loads(self.rfile.read(n) or b"{}")
+                queries = req.get("query")
+                if isinstance(queries, str):
+                    from .pgwire import _split_statements
+
+                    queries = [
+                        q for q in _split_statements(queries)
+                        if q.strip()
+                    ]
+                results = []
+                for q in queries or []:
+                    res = coordinator.execute(q)
+                    if res.kind == "rows":
+                        results.append(
+                            {
+                                "tag": f"SELECT {len(res.rows)}",
+                                "columns": list(res.columns),
+                                "rows": [list(r) for r in res.rows],
+                            }
+                        )
+                    elif res.kind == "text":
+                        results.append(
+                            {"tag": "EXPLAIN", "text": res.text}
+                        )
+                    elif res.kind == "subscription":
+                        res.subscription.close()
+                        results.append(
+                            {
+                                "error": "SUBSCRIBE is not supported "
+                                "over HTTP; use pgwire"
+                            }
+                        )
+                    else:
+                        results.append({"tag": "OK"})
+                body = json.dumps({"results": results}).encode()
+                self._reply(200, body, "application/json")
+            except Exception as e:
+                from ..sql.hir import PlanError
+                from ..sql.parser import ParseError
+
+                # Client mistakes are 400; execution faults (peek
+                # timeouts, internal errors) are the server's 500.
+                code = (
+                    400
+                    if isinstance(
+                        e, (PlanError, ParseError, json.JSONDecodeError)
+                    )
+                    else 500
+                )
+                body = json.dumps({"error": str(e)}).encode()
+                self._reply(code, body, "application/json")
+
+    return Handler
+
+
+class HttpServer:
+    def __init__(self, coordinator, host="127.0.0.1", port=0):
+        self._srv = ThreadingHTTPServer(
+            (host, port), make_handler(coordinator)
+        )
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+
+    def start(self) -> "HttpServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()

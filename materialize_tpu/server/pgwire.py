@@ -1,0 +1,308 @@
+"""PostgreSQL wire protocol v3: the SQL front door.
+
+Analog of the reference's ``src/pgwire`` (``protocol.rs:145 run()``,
+``:847 StateMachine``): startup handshake, the simple-query protocol
+(Query -> RowDescription/DataRow*/CommandComplete/ReadyForQuery), error
+responses, and SUBSCRIBE streamed via the COPY-out subprotocol (the
+reference streams TAIL/SUBSCRIBE the same way). Text result format only
+(the reference negotiates binary per column; text is always legal).
+No TLS/SCRAM — SSLRequest is politely refused with 'N' (plaintext), as
+the reference does when TLS is off.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import traceback
+
+from ..repr.schema import ColumnType
+from ..utils.trace import TRACER
+
+# PG type OIDs for the text protocol.
+_OIDS = {
+    ColumnType.BOOL: 16,
+    ColumnType.INT32: 23,
+    ColumnType.INT64: 20,
+    ColumnType.FLOAT64: 701,
+    ColumnType.DATE: 1082,
+    ColumnType.TIMESTAMP: 20,  # virtual time: expose as int8
+    ColumnType.DECIMAL: 1700,
+    ColumnType.STRING: 25,
+}
+
+PROTOCOL_V3 = 196608
+SSL_REQUEST = 80877103
+CANCEL_REQUEST = 80877102
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack("!I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+class PgConnection:
+    def __init__(self, sock: socket.socket, coordinator):
+        self.sock = sock
+        self.coord = coordinator
+        self.alive = True
+
+    # -- low-level ----------------------------------------------------------
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client disconnected")
+            buf += chunk
+        return buf
+
+    def _send(self, data: bytes) -> None:
+        self.sock.sendall(data)
+
+    # -- session ------------------------------------------------------------
+    def run(self) -> None:
+        try:
+            if not self._startup():
+                return
+            self._ready()
+            while self.alive:
+                tag = self.sock.recv(1)
+                if not tag:
+                    return
+                (length,) = struct.unpack("!I", self._recv_exact(4))
+                payload = self._recv_exact(length - 4)
+                if tag == b"Q":
+                    self._handle_query(payload[:-1].decode())
+                elif tag == b"X":
+                    return
+                elif tag in (b"P", b"B", b"D", b"E", b"S", b"C"):
+                    # Extended protocol: not implemented; report cleanly
+                    # once a Sync arrives.
+                    if tag == b"S":
+                        self._error(
+                            "0A000",
+                            "extended query protocol not supported; "
+                            "use simple queries",
+                        )
+                        self._ready()
+                else:
+                    self._error("08P01", f"unknown message {tag!r}")
+                    self._ready()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.sock.close()
+
+    def _startup(self) -> bool:
+        while True:
+            (length,) = struct.unpack("!I", self._recv_exact(4))
+            payload = self._recv_exact(length - 4)
+            (code,) = struct.unpack("!I", payload[:4])
+            if code == SSL_REQUEST:
+                self._send(b"N")  # no TLS; client retries plaintext
+                continue
+            if code == CANCEL_REQUEST:
+                return False
+            if code != PROTOCOL_V3:
+                self._error("08P01", f"unsupported protocol {code}")
+                return False
+            break
+        # AuthenticationOk + minimal parameters + key data.
+        self._send(_msg(b"R", struct.pack("!I", 0)))
+        for k, v in (
+            ("server_version", "9.5.0"),
+            ("server_name", "materialize_tpu"),
+            ("client_encoding", "UTF8"),
+            ("DateStyle", "ISO"),
+            ("integer_datetimes", "on"),
+        ):
+            self._send(_msg(b"S", _cstr(k) + _cstr(v)))
+        self._send(_msg(b"K", struct.pack("!II", 0, 0)))
+        return True
+
+    def _ready(self) -> None:
+        self._send(_msg(b"Z", b"I"))
+
+    def _error(self, code: str, message: str) -> None:
+        payload = (
+            b"S" + _cstr("ERROR")
+            + b"C" + _cstr(code)
+            + b"M" + _cstr(message)
+            + b"\x00"
+        )
+        self._send(_msg(b"E", payload))
+
+    # -- queries ------------------------------------------------------------
+    def _handle_query(self, sql: str) -> None:
+        with TRACER.span("pgwire.query", sql=sql[:100]):
+            for stmt in _split_statements(sql):
+                if not stmt.strip():
+                    self._send(_msg(b"I", b""))  # EmptyQueryResponse
+                    continue
+                try:
+                    res = self.coord.execute(stmt)
+                except Exception as e:  # planning/execution error
+                    self._error("XX000", str(e))
+                    self._ready()
+                    return
+                try:
+                    self._send_result(stmt, res)
+                except BrokenPipeError:
+                    raise
+        self._ready()
+
+    def _send_result(self, stmt: str, res) -> None:
+        if res.kind == "rows":
+            schema = self._result_schema(res)
+            self._row_description(res.columns, schema)
+            for row in res.rows:
+                self._data_row(row, schema)
+            self._complete(f"SELECT {len(res.rows)}")
+        elif res.kind == "text":
+            self._row_description(res.columns or ("explain",), None)
+            for line in res.text.split("\n"):
+                self._data_row((line,), None)
+            self._complete("EXPLAIN")
+        elif res.kind == "subscription":
+            self._stream_subscription(res)
+        else:
+            verb = stmt.strip().split()[0].upper()
+            self._complete(
+                f"INSERT 0 {res.affected}" if verb == "INSERT" else verb
+            )
+
+    def _result_schema(self, res):
+        # Column types: taken from the plan when available; text is a
+        # safe fallback for the wire's text format.
+        return getattr(res, "schema", None)
+
+    def _row_description(self, columns, schema) -> None:
+        parts = [struct.pack("!H", len(columns))]
+        for i, name in enumerate(columns):
+            oid = 25
+            if schema is not None and i < len(schema.columns):
+                oid = _OIDS.get(schema.columns[i].ctype, 25)
+            parts.append(
+                _cstr(str(name))
+                + struct.pack("!IhIhih", 0, 0, oid, -1, -1, 0)
+            )
+        self._send(_msg(b"T", b"".join(parts)))
+
+    def _data_row(self, row, schema) -> None:
+        parts = [struct.pack("!H", len(row))]
+        for v in row:
+            if v is None:
+                parts.append(struct.pack("!i", -1))
+            else:
+                if isinstance(v, bool):
+                    s = "t" if v else "f"
+                else:
+                    s = str(v)
+                b = s.encode()
+                parts.append(struct.pack("!i", len(b)) + b)
+        self._send(_msg(b"D", b"".join(parts)))
+
+    def _complete(self, tag: str) -> None:
+        self._send(_msg(b"C", _cstr(tag)))
+
+    def _stream_subscription(self, res) -> None:
+        """SUBSCRIBE over the COPY-out subprotocol: one text line per
+        update '(time, diff, cols...)', until the client disconnects
+        (the reference's SUBSCRIBE/TAIL wire behavior)."""
+        sub = res.subscription
+        # CopyOutResponse: text format, one column.
+        self._send(_msg(b"H", struct.pack("!bh", 0, 0)))
+        try:
+            while True:
+                got = sub.poll(timeout=1.0)
+                if got is None:
+                    # Heartbeat nothing; loop until client drops.
+                    try:
+                        self.sock.settimeout(0.001)
+                        peek = self.sock.recv(1, socket.MSG_PEEK)
+                        if peek == b"":
+                            return
+                    except socket.timeout:
+                        pass
+                    finally:
+                        self.sock.settimeout(None)
+                    continue
+                events, frontier = got
+                lines = []
+                for ev in events:
+                    *vals, t, d = ev
+                    fields = "\t".join(
+                        "\\N" if v is None else str(v) for v in vals
+                    )
+                    lines.append(f"{t}\t{d}\t{fields}\n")
+                lines.append(f"{frontier}\t0\tprogress\n")
+                self._send(
+                    _msg(b"d", "".join(lines).encode())
+                )
+        except (BrokenPipeError, ConnectionError, OSError):
+            pass
+        finally:
+            sub.close()
+
+
+def _split_statements(sql: str) -> list[str]:
+    """Split on ';' outside string literals (simple-query batches)."""
+    out, cur, in_str = [], [], False
+    for ch in sql:
+        if ch == "'":
+            in_str = not in_str
+            cur.append(ch)
+        elif ch == ";" and not in_str:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if "".join(cur).strip():
+        out.append("".join(cur))
+    return out
+
+
+class PgServer:
+    """TCP acceptor: one thread per connection (server-core analog)."""
+
+    def __init__(self, coordinator, host: str = "127.0.0.1", port: int = 0):
+        self.coord = coordinator
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, port))
+        self.sock.listen(16)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept, daemon=True)
+
+    def start(self) -> "PgServer":
+        self._thread.start()
+        return self
+
+    def _accept(self) -> None:
+        self.sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            PgConnection(conn, self.coord).run()
+        except Exception:
+            traceback.print_exc()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.sock.close()

@@ -1,0 +1,1 @@
+"""Test tooling: SLT runner (src/sqllogictest analog)."""

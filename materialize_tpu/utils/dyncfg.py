@@ -61,8 +61,13 @@ class ConfigSet:
         replicas."""
         with self._lock:
             for k, v in values.items():
+                if v is None:
+                    # None RESETS to the default (a stored None would
+                    # permanently mask it).
+                    self._values.pop(k, None)
+                    continue
                 cfg = self._configs.get(k)
-                if cfg is not None and v is not None:
+                if cfg is not None:
                     # Coerce to the default's type (flags arrive as
                     # strings from SQL/files).
                     t = type(cfg.default)

@@ -100,16 +100,19 @@ class Histogram(_Metric):
             return float("inf")
 
     def samples(self):
+        with self._lock:  # consistent with observe(): no torn scrapes
+            counts = list(self._counts)
+            total, sum_ = self._total, self._sum
         out = []
         acc = 0
-        for b, c in zip(self.buckets, self._counts):
+        for b, c in zip(self.buckets, counts):
             acc += c
             out.append((self.name + "_bucket", {"le": str(b)}, acc))
         out.append(
-            (self.name + "_bucket", {"le": "+Inf"}, acc + self._counts[-1])
+            (self.name + "_bucket", {"le": "+Inf"}, acc + counts[-1])
         )
-        out.append((self.name + "_sum", {}, self._sum))
-        out.append((self.name + "_count", {}, self._total))
+        out.append((self.name + "_sum", {}, sum_))
+        out.append((self.name + "_count", {}, total))
         return out
 
 

@@ -1,0 +1,186 @@
+"""Server front-end tests: pgwire protocol (raw-socket client),
+SQL-over-HTTP, /metrics, and the environmentd boot path (SURVEY.md L0)."""
+
+import json
+import socket
+import struct
+import urllib.request
+
+import pytest
+
+
+class MiniPg:
+    """A ~minimal PostgreSQL v3 simple-query client for tests (the
+    pgtest analog: wire-level assertions, src/pgtest)."""
+
+    def __init__(self, port: int):
+        self.sock = socket.create_connection(("127.0.0.1", port), 10)
+        payload = struct.pack("!I", 196608) + b"user\x00test\x00\x00"
+        self.sock.sendall(
+            struct.pack("!I", len(payload) + 4) + payload
+        )
+        self.params = {}
+        self._read_until_ready()
+
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            assert chunk, "server closed"
+            buf += chunk
+        return buf
+
+    def _read_msg(self):
+        tag = self._recv_exact(1)
+        (length,) = struct.unpack("!I", self._recv_exact(4))
+        return tag, self._recv_exact(length - 4)
+
+    def _read_until_ready(self):
+        msgs = []
+        while True:
+            tag, payload = self._read_msg()
+            msgs.append((tag, payload))
+            if tag == b"S":
+                k, v = payload.split(b"\x00")[:2]
+                self.params[k.decode()] = v.decode()
+            if tag == b"Z":
+                return msgs
+
+    def query(self, sql: str):
+        """Returns (columns, rows, error_message|None, complete_tag)."""
+        payload = sql.encode() + b"\x00"
+        self.sock.sendall(
+            b"Q" + struct.pack("!I", len(payload) + 4) + payload
+        )
+        columns, rows, error, tag_text = [], [], None, None
+        for tag, payload in self._read_until_ready():
+            if tag == b"T":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                for _ in range(n):
+                    end = payload.index(b"\x00", off)
+                    columns.append(payload[off:end].decode())
+                    off = end + 1 + 18
+            elif tag == b"D":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", payload[off : off + 4])
+                    off += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(payload[off : off + ln].decode())
+                        off += ln
+                rows.append(tuple(row))
+            elif tag == b"E":
+                fields = payload.split(b"\x00")
+                for f in fields:
+                    if f[:1] == b"M":
+                        error = f[1:].decode()
+            elif tag == b"C":
+                tag_text = payload[:-1].decode()
+        return columns, rows, error, tag_text
+
+    def close(self):
+        self.sock.sendall(b"X" + struct.pack("!I", 4))
+        self.sock.close()
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    from materialize_tpu.server.environmentd import Environment
+
+    e = Environment(
+        str(tmp_path_factory.mktemp("envd")),
+        n_replicas=1,
+        tick_interval=None,
+        in_process_replicas=True,
+    )
+    yield e
+    e.shutdown()
+
+
+class TestPgwire:
+    def test_handshake_and_basic_flow(self, env):
+        c = MiniPg(env.pg.port)
+        assert c.params.get("server_name") == "materialize_tpu"
+        _, _, err, tag = c.query("CREATE TABLE t (x bigint NOT NULL, s text)")
+        assert err is None and tag == "CREATE"
+        _, _, err, tag = c.query(
+            "INSERT INTO t VALUES (1, 'one'), (2, NULL)"
+        )
+        assert err is None
+        cols, rows, err, tag = c.query("SELECT x, s FROM t")
+        assert err is None
+        assert cols == ["x", "s"]
+        assert rows == [("1", "one"), ("2", None)]
+        assert tag == "SELECT 2"
+        c.close()
+
+    def test_errors_and_multi_statement(self, env):
+        c = MiniPg(env.pg.port)
+        _, _, err, _ = c.query("SELECT * FROM does_not_exist")
+        assert err and "does_not_exist" in err
+        # The session survives errors.
+        cols, rows, err, _ = c.query("SELECT name FROM mz_cluster_replicas")
+        assert err is None and rows == [("r0",)]
+        # Multi-statement batch: both run.
+        c.query("CREATE TABLE mt (a bigint NOT NULL)")
+        _, _, err, _ = c.query(
+            "INSERT INTO mt VALUES (1); INSERT INTO mt VALUES (2)"
+        )
+        assert err is None
+        _, rows, _, _ = c.query("SELECT count(*) FROM mt")
+        assert rows == [("2",)]
+        c.close()
+
+    def test_explain_over_wire(self, env):
+        c = MiniPg(env.pg.port)
+        _, rows, err, _ = c.query(
+            "EXPLAIN OPTIMIZED PLAN FOR SELECT count(*) FROM mt"
+        )
+        assert err is None
+        assert any("Reduce" in r[0] for r in rows)
+        c.close()
+
+    def test_subscribe_copy_out(self, env):
+        c = MiniPg(env.pg.port)
+        c.query("CREATE TABLE st (v bigint NOT NULL)")
+        c.query("INSERT INTO st VALUES (7)")
+        payload = b"SUBSCRIBE st\x00"
+        c.sock.sendall(
+            b"Q" + struct.pack("!I", len(payload) + 4) + payload
+        )
+        tag, _ = c._read_msg()
+        assert tag == b"H"  # CopyOutResponse
+        got = b""
+        while b"\t7\n" not in got and b"\t7" not in got:
+            tag, data = c._read_msg()
+            assert tag == b"d", tag
+            got += data
+        assert b"1\t7" in got or b"\t1\t7" in got
+        c.sock.close()  # drop mid-stream: server must clean up
+
+
+class TestHttp:
+    def test_sql_metrics_ready(self, env):
+        base = f"http://127.0.0.1:{env.http.port}"
+        with urllib.request.urlopen(base + "/api/readyz") as r:
+            assert r.read() == b"ready\n"
+        req = urllib.request.Request(
+            base + "/api/sql",
+            data=json.dumps(
+                {"query": "CREATE TABLE ht (x bigint NOT NULL); "
+                          "INSERT INTO ht VALUES (3); "
+                          "SELECT x FROM ht"}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert out["results"][-1]["rows"] == [[3]]
+        with urllib.request.urlopen(base + "/metrics") as r:
+            text = r.read().decode()
+        assert text.startswith("#") or text.strip() == ""
