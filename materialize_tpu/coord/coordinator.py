@@ -32,6 +32,7 @@ from ..sql.plan import (
     CreateSourcePlan,
     CreateTablePlan,
     CreateViewPlan,
+    CreateWebhookPlan,
     DropPlan,
     ExplainPlan,
     InsertPlan,
@@ -88,6 +89,7 @@ class Coordinator:
         # min(upper)-1 per involved shard set.
         self.oracle = TimestampOracle(persist.consensus, "tables")
         self._table_writers: dict[str, WriteHandle] = {}
+        self._webhooks: dict[str, WriteHandle] = {}
         self.sources: dict[str, GeneratorSource] = {}
         self.subscriptions: dict[int, Subscription] = {}
         self._sub_seq = 0
@@ -197,6 +199,8 @@ class Coordinator:
             return self._sequence_create_index(plan, sql, replay, record)
         if isinstance(plan, CreateTablePlan):
             return self._sequence_create_table(plan, sql, replay, record)
+        if isinstance(plan, CreateWebhookPlan):
+            return self._sequence_create_webhook(plan, sql, replay, record)
         if isinstance(plan, InsertPlan):
             return self._sequence_insert(plan)
         if isinstance(plan, SelectPlan):
@@ -231,8 +235,8 @@ class Coordinator:
         self, plan: CreateSourcePlan, sql, replay, record
     ) -> ExecuteResult:
         if not replay:
-            # Validate every name this source will claim BEFORE the
-            # durable record: subsource collisions too.
+            # Validate EVERYTHING that can fail BEFORE the durable
+            # record — a poison record would brick every future boot.
             from .sources import GENERATORS
 
             if plan.generator not in GENERATORS:
@@ -240,6 +244,19 @@ class Coordinator:
                     f"unknown load generator {plan.generator!r}"
                 )
             self._check_name_free(plan.name)
+            try:
+                # Adapter construction validates options (and gates
+                # unavailable backends like kafka).
+                GENERATORS[plan.generator](
+                    {
+                        str(k).lower().replace(" ", "_"): v
+                        for k, v in plan.options.items()
+                    }
+                )
+            except PlanError:
+                raise
+            except Exception as e:
+                raise PlanError(str(e)) from e
         if record is None:
             record = self._record_ddl(sql, {"name": plan.name})
         shard_prefix = f"u{record['id']}"
@@ -309,6 +326,73 @@ class Coordinator:
             )
         )
         return ExecuteResult("ok")
+
+    def _sequence_create_webhook(
+        self, plan: CreateWebhookPlan, sql, replay, record
+    ) -> ExecuteResult:
+        """A webhook source: rows arrive over HTTP (append_webhook), on
+        the source's own monotone timeline (webhook.rs analog)."""
+        if not replay:
+            self._check_name_free(plan.name)
+        if record is None:
+            record = self._record_ddl(sql, {"name": plan.name})
+        shard = f"u{record['id']}_webhook"
+        w = self.persist.open_writer(shard, plan.schema)
+        if w.upper == 0:
+            w.compare_and_append(
+                [np.zeros(0, c.dtype) for c in plan.schema.columns],
+                [None] * plan.schema.arity,
+                np.zeros(0, np.uint64),
+                np.zeros(0, np.int64),
+                0,
+                1,
+            )
+        self._webhooks[plan.name] = w
+        self.catalog.create(
+            CatalogItem(
+                name=plan.name,
+                kind="source",
+                schema=plan.schema,
+                definition={"shard": shard, "webhook": True},
+            )
+        )
+        return ExecuteResult("ok")
+
+    def append_webhook(self, name: str, rows: list) -> int:
+        """Ingest rows into a webhook source; returns the count. Rows
+        are python value tuples/lists matching the declared columns."""
+        with self._lock:
+            w = self._webhooks.get(name)
+            it = self.catalog.items.get(name)
+            if w is None or it is None:
+                raise PlanError(f"unknown webhook source {name!r}")
+            norm = []
+            for r in rows:
+                if len(r) != it.schema.arity:
+                    raise PlanError(
+                        f"webhook row has {len(r)} values, expected "
+                        f"{it.schema.arity}"
+                    )
+                for v, col in zip(r, it.schema.columns):
+                    if v is None and not col.nullable:
+                        raise PlanError(
+                            "null value in non-nullable column "
+                            f"{col.name!r}"
+                        )
+                norm.append(tuple(r))
+            if not norm:
+                return 0
+            cols, nulls = self._encode_insert(it.schema, norm)
+            t = w.upper
+            w.compare_and_append(
+                cols,
+                nulls,
+                np.full(len(norm), t, np.uint64),
+                np.ones(len(norm), np.int64),
+                t,
+                t + 1,
+            )
+            return len(norm)
 
     def _encode_insert(self, schema: Schema, rows: list):
         cols, nulls = [], []
@@ -635,6 +719,7 @@ class Coordinator:
                 src.stop()
                 for sub in src.adapter.subsources:
                     self.catalog.drop(sub, if_exists=True)
+            self._webhooks.pop(name, None)
         elif it.kind == "table":
             self._table_writers.pop(name, None)
         self.catalog.drop(name)

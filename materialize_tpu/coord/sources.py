@@ -129,6 +129,12 @@ class AuctionAdapter(GeneratorAdapter):
     def tick(self, tick: int, time: int) -> dict:
         return self.gen.tick(tick, time)
 
+    def recover(self, upto: int) -> None:
+        """Replay ticks to rebuild id counters and the live-bid window
+        after a restart (deterministic generator)."""
+        for i in range(1, upto):
+            self.gen.tick(i, i)
+
 
 class CounterAdapter(GeneratorAdapter):
     """The reference's COUNTER generator: appends one incrementing value
@@ -167,10 +173,172 @@ class CounterAdapter(GeneratorAdapter):
         }
 
 
+class UpsertState:
+    """ENVELOPE UPSERT: key -> latest value, converting a raw
+    (key, value) stream into retract/insert update pairs; a NULL value
+    is a tombstone (delete). The reference backs this state with RocksDB
+    on the storage host (storage/src/upsert.rs:26,506-530) — the analog
+    here is host-resident state beside the ingestion pipeline (the
+    DEVICE never sees raw upserts, only differential updates, exactly
+    like compute behind the reference's storage layer)."""
+
+    def __init__(self):
+        self.state: dict = {}
+
+    def apply(self, pairs: list) -> list:
+        """pairs: [(key_tuple, value_tuple | None)] in stream order ->
+        [(row_tuple, diff)] updates."""
+        out = []
+        for key, value in pairs:
+            old = self.state.get(key)
+            if old is not None:
+                out.append((key + old, -1))
+            if value is None:
+                self.state.pop(key, None)
+            else:
+                self.state[key] = value
+                out.append((key + value, +1))
+        return out
+
+
+class KeyValueAdapter(GeneratorAdapter):
+    """The reference's KEY VALUE load generator (source/generator/
+    key_value.rs): a keyed stream with repeated updates per key —
+    exercised with ENVELOPE UPSERT. Subsource rows: (key, partition,
+    value)."""
+
+    SCHEMA = Schema(
+        [
+            Column("key", ColumnType.INT64),
+            Column("partition", ColumnType.INT64),
+            Column("value", ColumnType.INT64),
+        ]
+    )
+
+    def __init__(self, options: dict):
+        self.n_keys = int(options.get("keys", 16))
+        self.partitions = int(options.get("partitions", 2))
+        self.updates_per_tick = int(options.get("updates_per_tick", 8))
+        self.seed = int(options.get("seed", 1))
+        envelope = str(options.get("envelope", "upsert")).lower()
+        if envelope not in ("upsert", "none"):
+            raise ValueError(f"unsupported envelope {envelope!r}")
+        self.envelope = envelope
+        self.upsert = UpsertState() if envelope == "upsert" else None
+        self.subsources = {"key_value": self.SCHEMA}
+
+    def _emit(self, raw_pairs: list, time: int) -> dict:
+        if self.upsert is not None:
+            updates = self.upsert.apply(raw_pairs)
+        else:
+            updates = [
+                (k + v, 1) for k, v in raw_pairs if v is not None
+            ]
+        if not updates:
+            return {}
+        rows = np.array([u[0] for u in updates], np.int64)
+        diffs = np.array([u[1] for u in updates], np.int64)
+        return {
+            "key_value": Batch.from_numpy(
+                self.SCHEMA,
+                [rows[:, 0], rows[:, 1], rows[:, 2]],
+                np.full(len(diffs), time, np.uint64),
+                diffs,
+            )
+        }
+
+    def _pairs(self, tick: int) -> list:
+        rng = np.random.default_rng(self.seed * 7919 + tick)
+        keys = rng.integers(0, self.n_keys, self.updates_per_tick)
+        vals = rng.integers(0, 1 << 31, self.updates_per_tick)
+        # Occasionally delete a key (tombstone).
+        dels = rng.random(self.updates_per_tick) < 0.1
+        out = []
+        for k, v, d in zip(keys, vals, dels):
+            key = (int(k), int(k) % self.partitions)
+            out.append((key, None if d else (int(v),)))
+        return out
+
+    def snapshot(self) -> dict:
+        return self._emit(self._pairs(0), 0)
+
+    def tick(self, tick: int, time: int) -> dict:
+        return self._emit(self._pairs(tick), time)
+
+    def recover(self, upto: int) -> None:
+        """Rebuild the upsert state after a restart by replaying the
+        deterministic (seeded per tick) raw stream up to the durable
+        frontier — the RocksDB-state rehydration analog."""
+        for i in range(upto):
+            if self.upsert is not None:
+                self.upsert.apply(self._pairs(i))
+
+
+class DatumsAdapter(GeneratorAdapter):
+    """The reference's DATUMS generator (source/generator/datums.rs):
+    one row exercising every device-representable type."""
+
+    SCHEMA = Schema(
+        [
+            Column("b", ColumnType.BOOL),
+            Column("i32", ColumnType.INT32),
+            Column("i64", ColumnType.INT64),
+            Column("f", ColumnType.FLOAT64),
+            Column("d", ColumnType.DATE),
+            Column("ts", ColumnType.TIMESTAMP),
+            Column("dec", ColumnType.DECIMAL, scale=2),
+            Column("s", ColumnType.STRING),
+            Column("n", ColumnType.INT64, nullable=True),
+        ]
+    )
+
+    def __init__(self, options: dict):
+        self.subsources = {"datums": self.SCHEMA}
+
+    def snapshot(self) -> dict:
+        from ..repr.schema import GLOBAL_DICT
+
+        cols = [
+            np.array([True, False]),
+            np.array([-1, 2], np.int32),
+            np.array([-(2**40), 2**40], np.int64),
+            np.array([-1.5, 2.25]),
+            np.array([0, 19000], np.int32),
+            np.array([0, 1_600_000_000_000], np.int64),
+            np.array([-123, 4567], np.int64),  # -1.23, 45.67
+            GLOBAL_DICT.encode_many(["", "hello"]),
+            np.array([0, 7], np.int64),
+        ]
+        return {
+            "datums": Batch.from_numpy(
+                self.SCHEMA,
+                cols,
+                np.zeros(2, np.uint64),
+                np.ones(2, np.int64),
+                nulls=[None] * 8 + [np.array([True, False])],
+            )
+        }
+
+
+class KafkaAdapter(GeneratorAdapter):
+    """Gated: Kafka needs librdkafka, which is not in this build. The
+    CREATE SOURCE surface exists so catalogs referencing Kafka fail
+    with a clear, actionable error instead of a parse error."""
+
+    def __init__(self, options: dict):
+        raise ValueError(
+            "KAFKA sources require librdkafka, which is not available "
+            "in this build; use a LOAD GENERATOR or WEBHOOK source"
+        )
+
+
 GENERATORS = {
     "tpch": TpchAdapter,
     "auction": AuctionAdapter,
     "counter": CounterAdapter,
+    "key_value": KeyValueAdapter,
+    "datums": DatumsAdapter,
+    "kafka": KafkaAdapter,
 }
 
 
@@ -214,6 +382,10 @@ class GeneratorSource:
         if self.t == 0:
             self._append_all(self.adapter.snapshot(), 0)
             self.t = 1
+        elif hasattr(self.adapter, "recover"):
+            # Stateful generators rebuild internal state by replaying
+            # their deterministic stream to the durable frontier.
+            self.adapter.recover(self.t)
 
     # -- ticking ------------------------------------------------------------
     def _append_batch(self, w: WriteHandle, b, lower: int, upper: int):

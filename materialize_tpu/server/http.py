@@ -27,6 +27,46 @@ def make_handler(coordinator):
             self.end_headers()
             self.wfile.write(body)
 
+        def _webhook(self, name: str) -> None:
+            """POST /api/webhook/<source>: body {"rows": [[...], ...]},
+            an array of rows [[...], ...], or one flat row [...]
+            (webhook sources, adapter/src/webhook.rs analog)."""
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if isinstance(body, dict) and "rows" in body:
+                    rows = body["rows"]
+                elif isinstance(body, list):
+                    if not body:
+                        rows = []  # empty batch: appended 0
+                    elif isinstance(body[0], list):
+                        rows = body
+                    else:
+                        rows = [body]  # one flat row
+                else:
+                    raise ValueError(
+                        'expected {"rows": [[...], ...]}, an array of '
+                        "rows, or one flat row array"
+                    )
+                count = coordinator.append_webhook(name, rows)
+                self._reply(
+                    200,
+                    json.dumps({"appended": count}).encode(),
+                    "application/json",
+                )
+            except Exception as e:
+                from ..sql.hir import PlanError
+
+                code = (
+                    400
+                    if isinstance(
+                        e, (PlanError, ValueError, json.JSONDecodeError)
+                    )
+                    else 500
+                )
+                body = json.dumps({"error": str(e)}).encode()
+                self._reply(code, body, "application/json")
+
         def do_GET(self):
             if self.path == "/metrics":
                 self._reply(
@@ -39,6 +79,9 @@ def make_handler(coordinator):
                 self._reply(404, b"not found\n", "text/plain")
 
         def do_POST(self):
+            if self.path.startswith("/api/webhook/"):
+                self._webhook(self.path[len("/api/webhook/"):])
+                return
             if self.path != "/api/sql":
                 self._reply(404, b"not found\n", "text/plain")
                 return

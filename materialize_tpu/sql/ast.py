@@ -270,6 +270,15 @@ class CreateTable(Statement):
 
 
 @dataclass(frozen=True)
+class CreateWebhook(Statement):
+    """CREATE SOURCE ... FROM WEBHOOK (cols): HTTP-ingested source
+    (the reference's webhook sources, adapter/src/webhook.rs)."""
+
+    name: str
+    columns: tuple  # (name, type_name, nullable) triples
+
+
+@dataclass(frozen=True)
 class Insert(Statement):
     table: str
     rows: tuple  # tuple of tuples of Expr (constant values)

@@ -163,6 +163,11 @@ class Parser:
 
     def _create_table(self) -> ast.Statement:
         name = self.expect_ident()
+        return ast.CreateTable(name, self._column_defs())
+
+    def _column_defs(self) -> tuple:
+        """'(' col type [NOT NULL|NULL], ... ')' — shared by CREATE
+        TABLE and CREATE SOURCE ... FROM WEBHOOK."""
         self.expect_sym("(")
         columns = []
         while True:
@@ -192,7 +197,7 @@ class Parser:
             if not self.accept_sym(","):
                 break
         self.expect_sym(")")
-        return ast.CreateTable(name, tuple(columns))
+        return tuple(columns)
 
     def expect_ident_or_number(self) -> str:
         t = self.peek()
@@ -233,6 +238,9 @@ class Parser:
     def _create_source(self):
         name = self.expect_ident()
         self.expect_kw("from")
+        if self.peek().text == "webhook":
+            self.next()
+            return ast.CreateWebhook(name, self._column_defs())
         self.expect_kw("load")
         self.expect_kw("generator")
         gen = self.expect_ident()

@@ -66,6 +66,12 @@ class CreateTablePlan(Plan):
 
 
 @dataclass
+class CreateWebhookPlan(Plan):
+    name: str
+    schema: Schema
+
+
+@dataclass
 class InsertPlan(Plan):
     table: str
     rows: list  # python value tuples, coerced to the table schema
@@ -123,6 +129,8 @@ def _plan(stmt: ast.Statement, catalog: CatalogInterface) -> Plan:
         return DropPlan(stmt.kind, stmt.name, stmt.if_exists)
     if isinstance(stmt, ast.CreateTable):
         return CreateTablePlan(stmt.name, _table_schema(stmt.columns))
+    if isinstance(stmt, ast.CreateWebhook):
+        return CreateWebhookPlan(stmt.name, _table_schema(stmt.columns))
     if isinstance(stmt, ast.Insert):
         return _plan_insert(stmt, catalog)
     if isinstance(stmt, ast.Subscribe):

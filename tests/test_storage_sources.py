@@ -1,0 +1,201 @@
+"""Storage-runtime tests: upsert envelope, key_value/datums generators,
+webhook sources, and stateful-generator resume (SURVEY.md §2.2 storage +
+storage/src/upsert.rs, source/generator/*)."""
+
+import json
+import socket
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from materialize_tpu.coord.sources import KeyValueAdapter, UpsertState
+
+from .oracle import as_multiset
+
+
+class TestUpsertState:
+    def test_retract_insert_and_tombstone(self):
+        u = UpsertState()
+        out = u.apply([((1,), (10,)), ((2,), (20,))])
+        assert out == [((1, 10), 1), ((2, 20), 1)]
+        out = u.apply([((1,), (11,))])
+        assert out == [((1, 10), -1), ((1, 11), 1)]
+        out = u.apply([((2,), None)])  # tombstone
+        assert out == [((2, 20), -1)]
+        out = u.apply([((2,), None)])  # delete of absent key: no-op
+        assert out == []
+
+    def test_multiset_invariant(self):
+        """After any update sequence, accumulated state has exactly one
+        row per live key (the upsert contract)."""
+        rng = np.random.default_rng(0)
+        u = UpsertState()
+        acc: dict = {}
+        for _ in range(200):
+            k = (int(rng.integers(0, 10)),)
+            v = (
+                None
+                if rng.random() < 0.2
+                else (int(rng.integers(0, 100)),)
+            )
+            for row, d in u.apply([(k, v)]):
+                acc[row] = acc.get(row, 0) + d
+            acc = {r: d for r, d in acc.items() if d}
+        keys = [r[0] for r in acc]
+        assert len(keys) == len(set(keys))
+        assert all(d == 1 for d in acc.values())
+
+
+class TestKeyValueResume:
+    def test_recover_rebuilds_state(self):
+        a = KeyValueAdapter({"keys": 8, "seed": 3})
+        updates = []
+        batches = [a.snapshot()] + [a.tick(i, i) for i in range(1, 6)]
+        # A restarted adapter that recovers to tick 6 continues with the
+        # SAME retractions as the uninterrupted one.
+        b = KeyValueAdapter({"keys": 8, "seed": 3})
+        b.recover(6)
+        assert a.upsert.state == b.upsert.state
+        nxt_a = a.tick(6, 6)
+        nxt_b = b.tick(6, 6)
+        ra = nxt_a["key_value"].to_rows() if nxt_a else []
+        rb = nxt_b["key_value"].to_rows() if nxt_b else []
+        assert ra == rb
+
+
+@pytest.fixture
+def env(tmp_path):
+    from materialize_tpu.server.environmentd import Environment
+
+    e = Environment(
+        str(tmp_path / "envd"),
+        n_replicas=1,
+        tick_interval=None,
+        in_process_replicas=True,
+    )
+    yield e
+    e.shutdown()
+
+
+class TestSourcesEndToEnd:
+    def test_key_value_upsert_mv(self, env):
+        coord = env.coord
+        coord.execute(
+            "CREATE SOURCE kv FROM LOAD GENERATOR key_value "
+            "(KEYS 8, UPDATES PER TICK 6, SEED 5)"
+        )
+        for _ in range(5):
+            coord.sources["kv"].tick_once()
+        res = coord.execute(
+            "SELECT key, count(*) AS n FROM key_value GROUP BY key"
+        )
+        # Upsert invariant: at most one live value per key.
+        assert all(r[1] == 1 for r in res.rows)
+
+    def test_datums_types(self, env):
+        coord = env.coord
+        coord.execute("CREATE SOURCE d FROM LOAD GENERATOR datums")
+        res = coord.execute(
+            "SELECT b, i64, s, n FROM datums WHERE i32 = 2"
+        )
+        assert res.rows == [(False, 2**40, "hello", 7)]
+        res = coord.execute("SELECT count(*) FROM datums WHERE n IS NULL")
+        assert res.rows == [(1,)]
+
+    def test_kafka_gated_without_poison_record(self, env):
+        """The gated-backend error must fire BEFORE the DDL is durably
+        recorded (a poison record would brick every future boot)."""
+        with pytest.raises(Exception) as e:
+            env.coord.execute("CREATE SOURCE k FROM LOAD GENERATOR kafka")
+        assert "librdkafka" in str(e.value)
+        assert not any(
+            rec.get("name") == "k"
+            for rec in env.coord._catalog_live_records()
+        )
+
+    def test_webhook_null_rejected_and_typed_columns(self, env):
+        coord = env.coord
+        coord.execute(
+            "CREATE SOURCE wtypes FROM WEBHOOK "
+            "(p numeric(10,2), d double precision, x bigint NOT NULL)"
+        )
+        with pytest.raises(Exception) as e:
+            coord.append_webhook("wtypes", [[1.5, 2.5, None]])
+        assert "non-nullable" in str(e.value)
+        assert coord.append_webhook("wtypes", []) == 0
+
+    def test_webhook_source(self, env):
+        coord = env.coord
+        coord.execute(
+            "CREATE SOURCE hooks FROM WEBHOOK "
+            "(id bigint NOT NULL, event text, score float)"
+        )
+        base = f"http://127.0.0.1:{env.http.port}"
+        req = urllib.request.Request(
+            base + "/api/webhook/hooks",
+            data=json.dumps(
+                {"rows": [[1, "click", 0.5], [2, "view", None]]}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["appended"] == 2
+        res = coord.execute("SELECT id, event, score FROM hooks")
+        assert res.rows == [(1, "click", 0.5), (2, "view", None)]
+        coord.execute(
+            "CREATE MATERIALIZED VIEW clicks AS "
+            "SELECT count(*) AS n FROM hooks WHERE event = 'click'"
+        )
+        with urllib.request.urlopen(
+            urllib.request.Request(
+                base + "/api/webhook/hooks",
+                data=json.dumps([[3, "click", 1.0]]).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+        ) as r:
+            assert json.loads(r.read())["appended"] == 1
+        res = coord.execute("SELECT * FROM clicks")
+        assert res.rows == [(2,)]
+        # Bad payloads are client errors.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    base + "/api/webhook/hooks",
+                    data=b'{"rows": [[1]]}',
+                    headers={"Content-Type": "application/json"},
+                )
+            )
+        assert e.value.code == 400
+
+    def test_webhook_survives_restart(self, tmp_path, env):
+        coord = env.coord
+        coord.execute(
+            "CREATE SOURCE wh FROM WEBHOOK (x bigint NOT NULL)"
+        )
+        coord.append_webhook("wh", [[5]])
+        from materialize_tpu.coord.coordinator import Coordinator
+        from materialize_tpu.storage.persist import (
+            FileBlob,
+            PersistClient,
+            SqliteConsensus,
+        )
+        import os
+
+        data = env.data_dir
+        coord2 = Coordinator(
+            PersistClient(
+                FileBlob(os.path.join(data, "blob")),
+                SqliteConsensus(os.path.join(data, "consensus.db")),
+            ),
+            tick_interval=None,
+        )
+        try:
+            coord2.append_webhook("wh", [[6]])
+            for name, rc in coord.controller.replicas.items():
+                coord2.add_replica(name, rc.addr)
+            res = coord2.execute("SELECT x FROM wh")
+            assert res.rows == [(5,), (6,)]
+        finally:
+            coord2.shutdown()
