@@ -33,12 +33,16 @@ from ..sql.plan import (
     CreateTablePlan,
     CreateViewPlan,
     CreateWebhookPlan,
+    DeletePlan,
     DropPlan,
     ExplainPlan,
     InsertPlan,
     SelectPlan,
+    SetVarPlan,
     ShowPlan,
+    ShowVarPlan,
     SubscribePlan,
+    UpdatePlan,
     plan_statement,
 )
 from ..storage.persist import PersistClient
@@ -203,6 +207,31 @@ class Coordinator:
             return self._sequence_create_webhook(plan, sql, replay, record)
         if isinstance(plan, InsertPlan):
             return self._sequence_insert(plan)
+        if isinstance(plan, DeletePlan):
+            return self._sequence_delete(plan)
+        if isinstance(plan, UpdatePlan):
+            return self._sequence_update(plan)
+        if isinstance(plan, SetVarPlan):
+            if plan.name not in COMPUTE_CONFIGS.current():
+                raise PlanError(
+                    f"unknown system variable {plan.name!r}"
+                )
+            try:
+                self.update_config({plan.name: plan.value})
+            except (TypeError, ValueError) as e:
+                raise PlanError(
+                    f"invalid value for {plan.name!r}: {e}"
+                ) from e
+            return ExecuteResult("ok")
+        if isinstance(plan, ShowVarPlan):
+            cur = COMPUTE_CONFIGS.current()
+            if plan.name not in cur:
+                raise PlanError(f"unknown system variable {plan.name!r}")
+            return ExecuteResult(
+                "rows",
+                rows=[(str(cur[plan.name]),)],
+                columns=(plan.name,),
+            )
         if isinstance(plan, SelectPlan):
             return self._sequence_peek(plan)
         if isinstance(plan, SubscribePlan):
@@ -214,10 +243,19 @@ class Coordinator:
                 "text", text=plan.text, columns=("explain",)
             )
         if isinstance(plan, ShowPlan):
+            kind = plan.kind.lower().rstrip("s")  # sources -> source
+            wanted = {
+                "object": None,  # all
+                "view": {"view", "materialized-view"},
+                "source": {"source"},
+                "table": {"table"},
+                "inde": {"index"},  # "indexes" -> "indexe"
+                "index": {"index"},
+            }.get("inde" if kind == "indexe" else kind, {kind})
             rows = sorted(
                 (it.name, it.kind)
                 for it in self.catalog.items.values()
-                if plan.kind in ("objects", it.kind)
+                if wanted is None or it.kind in wanted
             )
             return ExecuteResult("rows", rows=rows, columns=("name", "kind"))
         raise PlanError(f"cannot sequence {type(plan).__name__}")
@@ -417,42 +455,158 @@ class Coordinator:
         return cols, nulls
 
     def _sequence_insert(self, plan: InsertPlan) -> ExecuteResult:
-        it = self.catalog.items.get(plan.table)
-        if it is None or it.kind != "table":
-            raise PlanError(f"{plan.table!r} is not an insertable table")
-        # Group commit on the shared table timeline (coord/appends.rs +
-        # txn-wal): allocate one write timestamp past every table upper,
-        # write the target table, advance all other tables to the same
-        # upper with empty appends, then apply the write to the oracle.
+        it = self._check_writable_table(plan.table)
+        cols, nulls = self._encode_insert(it.schema, plan.rows)
+        self._group_commit(
+            plan.table, cols, nulls, np.ones(len(plan.rows), np.int64)
+        )
+        return ExecuteResult("ok", affected=len(plan.rows))
+
+    def _group_commit(self, table: str, cols, nulls, diffs) -> int:
+        """Group commit on the shared table timeline (coord/appends.rs
+        + txn-wal): allocate one write timestamp past every table
+        upper, write the target table, advance all other tables to the
+        same upper with empty appends, then apply the write to the
+        oracle. The ONE place the table-timeline protocol lives."""
         at_least = max(
             (w.upper for w in self._table_writers.values()), default=0
         )
         ts = self.oracle.write_ts(at_least=at_least)
-        w = self._table_writers[plan.table]
-        cols, nulls = self._encode_insert(it.schema, plan.rows)
+        w = self._table_writers[table]
         w.compare_and_append(
             cols,
             nulls,
-            np.full(len(plan.rows), ts, np.uint64),
-            np.ones(len(plan.rows), np.int64),
+            np.full(len(diffs), ts, np.uint64),
+            diffs,
             w.upper,
             ts + 1,
         )
         for name, other in self._table_writers.items():
-            if name != plan.table and other.upper <= ts:
+            if name != table and other.upper <= ts:
+                sch = self.catalog.items[name].schema
                 other.compare_and_append(
-                    [
-                        np.zeros(0, c.dtype)
-                        for c in self.catalog.items[name].schema.columns
-                    ],
-                    [None] * self.catalog.items[name].schema.arity,
+                    [np.zeros(0, c.dtype) for c in sch.columns],
+                    [None] * sch.arity,
                     np.zeros(0, np.uint64),
                     np.zeros(0, np.int64),
                     other.upper,
                     ts + 1,
                 )
         self.oracle.apply_write(ts)
-        return ExecuteResult("ok", affected=len(plan.rows))
+        return ts
+
+    # -- read-then-write DML ---------------------------------------------------
+    def _transient_peek(self, expr: mir.RelationExpr, unlocked: bool):
+        """Install a transient dataflow, peek it at the sources' latest
+        complete time, drop it; returns raw (vals..., time, diff) rows.
+        ``unlocked`` releases the sequencing lock during the wait —
+        safe for SELECT, NOT for DML whose read must be atomic with its
+        write."""
+        imports = self._source_imports(expr)
+        self._transient_seq += 1
+        name = f"t{self._transient_seq}"
+        self._register_dataflow(
+            DataflowDescription(
+                name=name, expr=expr, source_imports=imports,
+                sink_shard=None,
+            )
+        )
+        try:
+            as_of = self._select_timestamp_shards(
+                self._df_upstream.get(name, [])
+            )
+            if unlocked:
+                with self._unlocked():
+                    rows, _ = self.controller.peek(
+                        name, as_of=as_of, timeout=PEEK_TIMEOUT
+                    )
+            else:
+                rows, _ = self.controller.peek(
+                    name, as_of=as_of, timeout=PEEK_TIMEOUT
+                )
+        finally:
+            self.controller.drop_dataflow(name)
+            self._df_upstream.pop(name, None)
+        return rows
+
+    def _read_rows_multiset(self, expr: mir.RelationExpr) -> dict:
+        """The read half of DELETE/UPDATE's read-then-write: runs UNDER
+        the sequencing lock so concurrent DML cannot double-retract
+        (the reference serializes table writes through group commit)."""
+        opt = optimize(self._inline_views(expr))
+        rows = self._transient_peek(opt, unlocked=False)
+        acc: dict = {}
+        for r in rows:
+            acc[r[:-2]] = acc.get(r[:-2], 0) + r[-1]
+        return {k: v for k, v in acc.items() if v}
+
+    def _encode_internal(self, schema: Schema, rows: list):
+        """Encode DECODED result rows back to device representation:
+        strings re-encode to dictionary codes; decimals are ALREADY
+        internally scaled (unlike _encode_insert's user-value path)."""
+        cols, nulls = [], []
+        for j, col in enumerate(schema.columns):
+            vals, mask = [], []
+            for r in rows:
+                v = r[j]
+                mask.append(v is None)
+                if v is None:
+                    vals.append(0)
+                elif col.ctype is ColumnType.STRING:
+                    vals.append(GLOBAL_DICT.encode(str(v)))
+                else:
+                    vals.append(v)
+            cols.append(np.asarray(vals, dtype=col.dtype))
+            nulls.append(np.asarray(mask, bool) if any(mask) else None)
+        return cols, nulls
+
+    def _table_write(self, table: str, updates: list) -> None:
+        """Group-commit a batch of INTERNALLY-represented (row, diff)
+        updates (the DELETE/UPDATE write half)."""
+        it = self.catalog.items[table]
+        rows = [u[0] for u in updates]
+        diffs = np.array([u[1] for u in updates], np.int64)
+        cols, nulls = self._encode_internal(it.schema, rows)
+        self._group_commit(table, cols, nulls, diffs)
+
+    def _check_writable_table(self, name: str):
+        it = self.catalog.items.get(name)
+        if it is None or it.kind != "table":
+            raise PlanError(f"{name!r} is not a writable table")
+        return it
+
+    def _sequence_delete(self, plan: DeletePlan) -> ExecuteResult:
+        self._check_writable_table(plan.table)
+        matched = self._read_rows_multiset(plan.expr)
+        if not matched:
+            return ExecuteResult("ok", affected=0)
+        updates = [(vals, -mult) for vals, mult in matched.items()]
+        n = sum(m for m in matched.values())
+        self._table_write(plan.table, updates)
+        return ExecuteResult("ok", affected=n)
+
+    def _sequence_update(self, plan: UpdatePlan) -> ExecuteResult:
+        it = self._check_writable_table(plan.table)
+        arity = it.schema.arity
+        matched = self._read_rows_multiset(plan.expr)
+        if not matched:
+            return ExecuteResult("ok", affected=0)
+        updates = []
+        n = 0
+        for vals, mult in matched.items():
+            old = vals[:arity]
+            new = list(old)
+            for tgt, src_pos in plan.set_positions.items():
+                new[tgt] = _coerce_internal(
+                    vals[src_pos],
+                    plan.expr_schema.columns[src_pos],
+                    it.schema.columns[tgt],
+                )
+            updates.append((old, -mult))
+            updates.append((tuple(new), mult))
+            n += mult
+        self._table_write(plan.table, updates)
+        return ExecuteResult("ok", affected=n)
 
     # -- subscribe ------------------------------------------------------------
     def _sequence_subscribe(self, plan: SubscribePlan) -> ExecuteResult:
@@ -785,7 +939,9 @@ class Coordinator:
         df.step({})
         rows = _decode_peek_rows(df.output.batch)
         return ExecuteResult(
-            "rows", rows=_finish(rows), columns=plan.column_names,
+            "rows",
+            rows=_finish(rows, plan.order_by),
+            columns=plan.column_names,
             schema=expr.schema(),
         )
 
@@ -810,35 +966,18 @@ class Coordinator:
                     df, as_of=as_of, timeout=PEEK_TIMEOUT
                 )
             return ExecuteResult(
-                "rows", rows=_finish(rows), columns=plan.column_names,
+                "rows",
+                rows=_finish(rows, plan.order_by),
+                columns=plan.column_names,
                 schema=expr.schema(),
             )
         # Slow path: transient dataflow, peek, drop (life-of-a-query
         # slow path).
-        imports = self._source_imports(expr)
-        self._transient_seq += 1
-        name = f"t{self._transient_seq}"
-        self._register_dataflow(
-            DataflowDescription(
-                name=name,
-                expr=expr,
-                source_imports=imports,
-                sink_shard=None,
-            )
-        )
-        try:
-            as_of = self._select_timestamp_shards(
-                self._df_upstream.get(name, [])
-            )
-            with self._unlocked():
-                rows, _ = self.controller.peek(
-                    name, as_of=as_of, timeout=PEEK_TIMEOUT
-                )
-        finally:
-            self.controller.drop_dataflow(name)
-            self._df_upstream.pop(name, None)
+        rows = self._transient_peek(expr, unlocked=True)
         return ExecuteResult(
-            "rows", rows=_finish(rows), columns=plan.column_names,
+            "rows",
+            rows=_finish(rows, plan.order_by),
+            columns=plan.column_names,
             schema=expr.schema(),
         )
 
@@ -860,9 +999,12 @@ class Coordinator:
 
     def update_config(self, values: dict) -> None:
         """Apply dyncfg updates and propagate to replicas in
-        command-stream order (dyncfg sync + UpdateConfiguration)."""
-        full = COMPUTE_CONFIGS.update(values)
-        self.controller.update_configuration(full)
+        command-stream order (dyncfg sync + UpdateConfiguration). Raw
+        DELTAS are shipped (None = reset-to-default) so resets reach
+        replicas and reconnect replay stays faithful — a full override
+        map would silently drop resets."""
+        COMPUTE_CONFIGS.update(values)
+        self.controller.update_configuration(dict(values))
 
     def shutdown(self) -> None:
         for sub in list(self.subscriptions.values()):
@@ -915,17 +1057,66 @@ class Subscription:
         self.reader.expire()
 
 
-def _finish(rows: list) -> list:
+def _coerce_internal(v, from_col: Column, to_col: Column):
+    """Coerce an internally-represented value between column types
+    (UPDATE SET expression -> target column)."""
+    if v is None:
+        if not to_col.nullable:
+            raise PlanError(
+                f"null value in non-nullable column {to_col.name!r}"
+            )
+        return None
+    if to_col.ctype is ColumnType.DECIMAL:
+        if from_col.ctype is ColumnType.DECIMAL:
+            shift = to_col.scale - from_col.scale
+            return int(v) * 10**shift if shift >= 0 else int(v) // (
+                10 ** (-shift)
+            )
+        return round(float(v) * 10**to_col.scale)
+    if to_col.ctype is ColumnType.FLOAT64:
+        if from_col.ctype is ColumnType.DECIMAL:
+            return float(v) / 10**from_col.scale
+        return float(v)
+    if to_col.ctype is ColumnType.STRING:
+        return str(v)
+    if to_col.ctype is ColumnType.BOOL:
+        return bool(v)
+    return int(v)
+
+
+def _finish(rows: list, order_by: tuple = ()) -> list:
     """Collapse (cols..., time, diff) into SELECT result rows with
-    multiplicities expanded (RowSetFinishing application, coord/peek.rs).
-    NULLs (None) sort first, as in the reference's Datum ordering."""
+    multiplicities expanded and the query's ORDER BY applied
+    (RowSetFinishing application, coord/peek.rs:910). Without an ORDER
+    BY, rows sort by full value for determinism; NULLs sort first (ASC)
+    as in the reference's Datum ordering."""
     acc: dict = {}
     for r in rows:
         acc[r[:-2]] = acc.get(r[:-2], 0) + r[-1]
 
-    def key(vals):
-        return tuple((v is not None, v if v is not None else 0)
-                     for v in vals)
+    def default_key(vals):
+        return tuple(
+            (v is not None, v if v is not None else 0) for v in vals
+        )
+
+    if order_by:
+
+        def key(vals):
+            parts = []
+            for idx, desc, nulls_last in order_by:
+                v = vals[idx]
+                null_rank = (v is None) == nulls_last  # False sorts first
+                if v is None:
+                    parts.append((null_rank, _Rev(0) if desc else 0))
+                else:
+                    parts.append(
+                        (null_rank, _Rev(v) if desc else v)
+                    )
+            # Full-row tiebreak keeps output deterministic.
+            return (tuple(parts), default_key(vals))
+
+    else:
+        key = default_key
 
     out = []
     for vals in sorted(acc.keys(), key=key):
@@ -937,6 +1128,21 @@ def _finish(rows: list) -> list:
             )
         out.extend([vals] * mult)
     return out
+
+
+class _Rev:
+    """Reverses comparison order for DESC sort keys."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+    def __lt__(self, other):
+        return other.v < self.v
 
 
 def _rewrite_children(e: mir.RelationExpr, fn) -> mir.RelationExpr:

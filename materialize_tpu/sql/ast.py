@@ -286,6 +286,30 @@ class Insert(Statement):
 
 
 @dataclass(frozen=True)
+class Delete(Statement):
+    table: str
+    where: Optional[Expr] = None
+
+
+@dataclass(frozen=True)
+class Update(Statement):
+    table: str
+    assignments: tuple  # (column_name, Expr)
+    where: Optional[Expr] = None
+
+
+@dataclass(frozen=True)
+class SetVar(Statement):
+    name: str
+    value: object  # python scalar or None (RESET)
+
+
+@dataclass(frozen=True)
+class ShowVar(Statement):
+    name: str
+
+
+@dataclass(frozen=True)
 class DropObject(Statement):
     kind: str  # view/index/source
     name: str

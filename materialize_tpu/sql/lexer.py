@@ -36,6 +36,7 @@ KEYWORDS = {
     "min", "max", "avg", "coalesce", "interval", "extract", "year",
     "default", "return", "at", "recursion", "tpch", "auction", "counter",
     "scale", "factor", "up", "to", "tick", "in", "columns",
+    "delete", "update", "set",
 }
 
 SYMBOLS = (

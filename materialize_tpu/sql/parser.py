@@ -103,6 +103,47 @@ class Parser:
             return self._drop()
         if self.accept_kw("insert"):
             return self._insert()
+        if self.accept_kw("delete"):
+            self.expect_kw("from")
+            table = self.expect_ident()
+            where = None
+            if self.accept_kw("where"):
+                where = self.parse_expr()
+            return ast.Delete(table, where)
+        if self.accept_kw("update"):
+            table = self.expect_ident()
+            self.expect_kw("set")
+            assignments = []
+            while True:
+                col = self.expect_ident()
+                self.expect_sym("=")
+                assignments.append((col, self.parse_expr()))
+                if not self.accept_sym(","):
+                    break
+            where = None
+            if self.accept_kw("where"):
+                where = self.parse_expr()
+            return ast.Update(table, tuple(assignments), where)
+        if self.accept_kw("set"):
+            name = self.expect_ident()
+            if not self.accept_sym("="):
+                self.expect_kw("to")
+            t = self.peek()
+            if t.kind is TokKind.NUMBER:
+                self.next()
+                value = float(t.text) if "." in t.text else int(t.text)
+            elif t.kind is TokKind.STRING:
+                self.next()
+                value = t.text
+            elif t.is_kw("default"):
+                self.next()
+                value = None
+            elif t.is_kw("true") or t.is_kw("false"):
+                self.next()
+                value = t.text == "true"
+            else:
+                value = self.expect_ident()
+            return ast.SetVar(name, value)
         if self.accept_kw("subscribe"):
             self.accept_kw("to")
             t = self.peek()
@@ -123,7 +164,12 @@ class Parser:
             return ast.Subscribe(self.parse_query())
         if self.accept_kw("show"):
             kind = self.expect_ident()
-            return ast.ShowObjects(kind)
+            if kind.lower() in (
+                "objects", "sources", "views", "indexes", "tables",
+                "source", "view", "index", "table",
+            ):
+                return ast.ShowObjects(kind)
+            return ast.ShowVar(kind)  # SHOW <system variable>
         return ast.SelectStatement(self.parse_query())
 
     # -- DDL ---------------------------------------------------------------

@@ -28,6 +28,9 @@ class Plan:
 class SelectPlan(Plan):
     expr: mir.RelationExpr
     column_names: tuple
+    # RowSetFinishing ordering: (col_idx, desc, nulls_last) triples,
+    # applied adapter-side to peek results (coord/peek.rs:910 analog).
+    order_by: tuple = ()
 
 
 @dataclass
@@ -78,6 +81,36 @@ class InsertPlan(Plan):
 
 
 @dataclass
+class DeletePlan(Plan):
+    """Read-then-write: the expr selects the rows to retract."""
+
+    table: str
+    expr: mir.RelationExpr
+
+
+@dataclass
+class UpdatePlan(Plan):
+    """Read-then-write: expr = SELECT *, new_values... FROM t WHERE p;
+    set_positions maps target column index -> appended column index."""
+
+    table: str
+    expr: mir.RelationExpr
+    set_positions: dict
+    expr_schema: Schema
+
+
+@dataclass
+class SetVarPlan(Plan):
+    name: str
+    value: object  # None = RESET to default
+
+
+@dataclass
+class ShowVarPlan(Plan):
+    name: str
+
+
+@dataclass
 class SubscribePlan(Plan):
     expr: mir.RelationExpr
     column_names: tuple
@@ -108,7 +141,9 @@ def _plan(stmt: ast.Statement, catalog: CatalogInterface) -> Plan:
     if isinstance(stmt, ast.SelectStatement):
         hir_rel, scope = qp.plan_query(stmt.query)
         return SelectPlan(
-            lower(hir_rel), tuple(it.name for it in scope.items)
+            lower(hir_rel),
+            tuple(it.name for it in scope.items),
+            getattr(qp, "finishing_order", ()),
         )
     if isinstance(stmt, ast.CreateView):
         hir_rel, scope = qp.plan_query(stmt.query)
@@ -133,6 +168,15 @@ def _plan(stmt: ast.Statement, catalog: CatalogInterface) -> Plan:
         return CreateWebhookPlan(stmt.name, _table_schema(stmt.columns))
     if isinstance(stmt, ast.Insert):
         return _plan_insert(stmt, catalog)
+    if isinstance(stmt, ast.Delete):
+        hir_rel, _ = qp.plan_query(_table_query(stmt.table, stmt.where))
+        return DeletePlan(stmt.table, lower(hir_rel))
+    if isinstance(stmt, ast.Update):
+        return _plan_update(stmt, catalog, qp)
+    if isinstance(stmt, ast.SetVar):
+        return SetVarPlan(stmt.name, stmt.value)
+    if isinstance(stmt, ast.ShowVar):
+        return ShowVarPlan(stmt.name)
     if isinstance(stmt, ast.Subscribe):
         hir_rel, scope = qp.plan_query(stmt.query)
         return SubscribePlan(
@@ -182,6 +226,48 @@ def _eval_literal(e: ast.Expr):
     raise PlanError(
         f"INSERT values must be constants, got {type(e).__name__}"
     )
+
+
+def _table_query(
+    table: str, where, extra_items: tuple = ()
+) -> ast.Query:
+    """Build `SELECT *, extra... FROM table WHERE ...` programmatically
+    (read-then-write DML plans over the ordinary query planner)."""
+    items = (ast.SelectItem(ast.Star(None)),) + tuple(
+        ast.SelectItem(e) for e in extra_items
+    )
+    return ast.Query(
+        body=ast.SelectExpr(
+            ast.Select(
+                items=items,
+                from_=(ast.FromItem(ast.TableName(table)),),
+                where=where,
+            )
+        )
+    )
+
+
+def _plan_update(
+    stmt: ast.Update, catalog: CatalogInterface, qp
+) -> Plan:
+    schema = catalog.resolve_item(stmt.table)
+    names = list(schema.names)
+    set_positions = {}
+    exprs = []
+    for j, (col, e) in enumerate(stmt.assignments):
+        if col not in names:
+            raise PlanError(
+                f"unknown column {col!r} in table {stmt.table!r}"
+            )
+        if names.index(col) in set_positions:
+            raise PlanError(f"column {col!r} assigned more than once")
+        set_positions[names.index(col)] = schema.arity + j
+        exprs.append(e)
+    hir_rel, _ = qp.plan_query(
+        _table_query(stmt.table, stmt.where, tuple(exprs))
+    )
+    expr = lower(hir_rel)
+    return UpdatePlan(stmt.table, expr, set_positions, expr.schema())
 
 
 def _plan_insert(stmt: ast.Insert, catalog: CatalogInterface) -> Plan:

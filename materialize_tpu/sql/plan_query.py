@@ -112,11 +112,22 @@ class QueryPlanner:
             self._ctes = saved
 
     def _apply_finishing(self, rel, scope, q: ast.Query):
+        # Result-order finishing (RowSetFinishing): recorded for the
+        # adapter to apply to peek results. Nested plan_query calls run
+        # before the outermost _apply_finishing, so the last write is
+        # the top-level query's ordering.
+        self.finishing_order = ()
         if q.order_by:
             order = []
             for ob in q.order_by:
                 if isinstance(ob.expr, ast.NumberLit):
-                    idx = int(ob.expr.text) - 1  # ORDER BY 2
+                    ordinal = int(ob.expr.text)  # ORDER BY 2
+                    if not 1 <= ordinal <= len(scope.items):
+                        raise PlanError(
+                            f"ORDER BY position {ordinal} is not in "
+                            f"the select list (1..{len(scope.items)})"
+                        )
+                    idx = ordinal - 1
                 else:
                     idx = scope.resolve(_ident_parts(ob.expr))
                 nulls_last = (
@@ -125,6 +136,7 @@ class QueryPlanner:
                     else not ob.desc  # PG default: ASC->LAST, DESC->FIRST
                 )
                 order.append((idx, ob.desc, nulls_last))
+            self.finishing_order = tuple(order)
             if q.limit is not None or q.offset:
                 rel = HTopK(rel, (), tuple(order), q.limit, q.offset)
             # bare ORDER BY on an unordered collection is a no-op (the
