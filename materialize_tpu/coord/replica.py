@@ -71,6 +71,7 @@ class ReplicaWorker:
         location: PersistLocation | None = None,
         persist_client: PersistClient | None = None,
         replica_id: str = "r0",
+        workers: int = 1,
     ):
         if persist_client is not None:
             self.client = persist_client
@@ -81,6 +82,24 @@ class ReplicaWorker:
                 SqliteConsensus(location.consensus_path),
             )
         self.replica_id = replica_id
+        # Workers per replica = devices in the SPMD mesh
+        # (TimelyConfig.workers analog, cluster-client/src/client.rs:19):
+        # 1 = single-device dataflows; N = shard_map over an N-device
+        # mesh with all_to_all exchange. Validated NOW: a device-count
+        # misconfiguration is permanent and must fail replica boot, not
+        # get retried as a transient hydration race per dataflow.
+        if workers > 1:
+            import jax
+
+            n = len(jax.devices())
+            if workers > n:
+                raise ValueError(
+                    f"--workers {workers} exceeds available devices "
+                    f"({n}); set XLA_FLAGS="
+                    "--xla_force_host_platform_device_count for CPU "
+                    "meshes"
+                )
+        self.workers = workers
         self.epoch = -1
         self.dataflows: dict[str, _Installed] = {}
         self.pending_peeks: list[dict] = []
@@ -227,6 +246,16 @@ class ReplicaWorker:
             if not worked:
                 _time.sleep(0.002)  # park
 
+    def _make_dataflow(self, desc: DataflowDescription):
+        if self.workers <= 1:
+            return Dataflow(desc.expr, name=desc.name)
+        from ..parallel.mesh import make_mesh
+        from ..render.dataflow import ShardedDataflow
+
+        return ShardedDataflow(
+            desc.expr, make_mesh(self.workers), name=desc.name
+        )
+
     def _build(self, desc: DataflowDescription) -> _Installed:
         """Build (or rebuild) a dataflow. Hydration can race with an
         active-active sibling writing the same sink (SinkConflict) or
@@ -239,7 +268,7 @@ class ReplicaWorker:
                     desc,
                     MaintainedView(
                         self.client,
-                        Dataflow(desc.expr, name=desc.name),
+                        self._make_dataflow(desc),
                         desc.source_imports,
                         desc.sink_shard,
                     ),
@@ -346,7 +375,7 @@ class ReplicaWorker:
             if as_of is not None and inst.view.upper <= as_of:
                 keep.append(p)  # not yet complete at as_of
                 continue
-            rows = _result_rows(inst.view.df.output.batch)
+            rows = _result_rows(inst.view.result_batch())
             ctp.send_msg(
                 conn,
                 {
@@ -371,8 +400,12 @@ class ReplicaWorker:
                 inst.reported_upper = upper
                 # Arrangement introspection (mz_arrangement_sizes
                 # analog): the output arrangement's current row count.
-                # One scalar device->host read, only on frontier change.
-                records[name] = int(inst.view.df.output.batch.count)
+                # One small device->host read, only on frontier change.
+                import numpy as _np
+
+                records[name] = int(
+                    _np.asarray(inst.view.df.output.batch.count).sum()
+                )
         if changed:
             ctp.send_msg(
                 conn,
@@ -392,8 +425,11 @@ def serve_forever(
     location: PersistLocation,
     replica_id: str = "r0",
     ready_event: threading.Event | None = None,
+    workers: int = 1,
 ) -> None:
-    worker = ReplicaWorker(location=location, replica_id=replica_id)
+    worker = ReplicaWorker(
+        location=location, replica_id=replica_id, workers=workers
+    )
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     sock.bind(("127.0.0.1", port))
@@ -422,12 +458,17 @@ def main() -> None:
     ap.add_argument("--blob", required=True)
     ap.add_argument("--consensus", required=True)
     ap.add_argument("--replica-id", default="r0")
+    ap.add_argument(
+        "--workers", type=int, default=1,
+        help="devices in this replica's SPMD mesh",
+    )
     args = ap.parse_args()
     print(f"replica {args.replica_id} listening on {args.port}", flush=True)
     serve_forever(
         args.port,
         PersistLocation(args.blob, args.consensus),
         args.replica_id,
+        workers=args.workers,
     )
 
 

@@ -781,6 +781,13 @@ class _DataflowBase:
         delta (device-resident) and advances the frontier."""
         return self.run_steps([inputs])[-1]
 
+    def gather_delta(self, out: Batch) -> Batch:
+        """Host view of a step's output delta. Single-device dataflows
+        are already host-readable; ShardedDataflow overrides this to
+        gather per-worker shards. Callers (MaintainedView) use this
+        uniformly instead of duck-typing on the dataflow class."""
+        return out
+
     def run_steps(self, inputs_list: list) -> list:
         """Feed several micro-batches with deferred overflow handling:
         all steps are submitted asynchronously, the packed overflow flags

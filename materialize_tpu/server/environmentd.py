@@ -34,7 +34,9 @@ def _free_port() -> int:
     return port
 
 
-def spawn_replica(data_dir: str, port: int, rid: str) -> subprocess.Popen:
+def spawn_replica(
+    data_dir: str, port: int, rid: str, workers: int = 1
+) -> subprocess.Popen:
     """One clusterd subprocess (orchestrator-process analog)."""
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", ""))
@@ -45,6 +47,7 @@ def spawn_replica(data_dir: str, port: int, rid: str) -> subprocess.Popen:
             "--blob", os.path.join(data_dir, "blob"),
             "--consensus", os.path.join(data_dir, "consensus.db"),
             "--replica-id", rid,
+            "--workers", str(workers),
         ],
         env=env,
     )
@@ -59,6 +62,7 @@ class Environment:
         pg_port: int = 0,
         http_port: int = 0,
         n_replicas: int = 1,
+        workers: int = 1,
         tick_interval: float | None = 0.05,
         in_process_replicas: bool = False,
     ):
@@ -88,13 +92,16 @@ class Environment:
                         rid,
                         ready,
                     ),
+                    kwargs={"workers": workers},
                     daemon=True,
                 )
                 t.start()
                 ready.wait(10)
                 self._threads.append(t)
             else:
-                self.procs.append(spawn_replica(data_dir, port, rid))
+                self.procs.append(
+                    spawn_replica(data_dir, port, rid, workers)
+                )
             replica_ports.append((rid, port))
         self.coord = Coordinator(
             PersistClient(
@@ -135,6 +142,10 @@ def main() -> None:
     ap.add_argument("--http-port", type=int, default=6876)
     ap.add_argument("--replicas", type=int, default=1)
     ap.add_argument(
+        "--workers", type=int, default=1,
+        help="devices per replica (SPMD mesh size)",
+    )
+    ap.add_argument(
         "--tick-interval", type=float, default=0.05,
         help="load-generator tick seconds",
     )
@@ -144,6 +155,7 @@ def main() -> None:
         pg_port=args.pg_port,
         http_port=args.http_port,
         n_replicas=args.replicas,
+        workers=args.workers,
         tick_interval=args.tick_interval,
     )
     atexit.register(env.shutdown)

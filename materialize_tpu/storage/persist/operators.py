@@ -201,7 +201,7 @@ class MaintainedView:
                 inputs[name] = b
             self.df.time = as_of
             self.df.step(inputs)
-            out = self._output_snapshot_delta()
+            out = self.result_batch()
             self._append(out, 0, as_of + 1, as_of)
             self._upper = as_of + 1
         else:
@@ -215,9 +215,11 @@ class MaintainedView:
             # already durable — do NOT append.
             self._upper = out_upper
 
-    def _output_snapshot_delta(self) -> Batch:
-        # After hydration the output arrangement IS the initial delta.
-        return self.df.output.batch
+
+    def result_batch(self) -> Batch:
+        """The maintained output arrangement as a HOST-readable batch
+        (SPMD dataflows gather their per-worker shards first)."""
+        return self.df.gather_delta(self.df.output.batch)
 
     def _append(self, batch: Batch, lower: int, upper: int, t: int) -> None:
         """Append the step's output delta. In active-active replication
@@ -296,6 +298,7 @@ class MaintainedView:
         t = target - 1
         self.df.time = t
         out = self.df.step(polled)
+        out = self.df.gather_delta(out)  # no-op on single-device
         self._append(out, lower, target, t)
         self._upper = target
         return True
