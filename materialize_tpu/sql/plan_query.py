@@ -341,11 +341,13 @@ class QueryPlanner:
         key_sources: list[ast.Expr] = list(sel.group_by)
         pre_scalars: list = []
         key_indices: list[int] = []
+        resolved_keys: list[ast.Expr] = []
         for ge in key_sources:
             if isinstance(ge, ast.NumberLit):  # GROUP BY 1
                 e, _ = items[int(ge.text) - 1]
             else:
                 e = ge
+            resolved_keys.append(e)
             h = self.plan_expr(e, scope)
             if isinstance(h, HColumn):
                 key_indices.append(h.index)
@@ -402,11 +404,19 @@ class QueryPlanner:
         agg_refs: dict[int, list] = {}
 
         def rewrite(e: ast.Expr):
-            """Replace aggregate calls with post-reduce column refs."""
+            """Replace aggregate calls with post-reduce column refs;
+            a select item STRUCTURALLY equal to a GROUP BY expression
+            references that key column (the reference's group-key
+            matching in sql/src/plan/query.rs)."""
+            for kpos, ke in enumerate(resolved_keys):
+                if e == ke and not isinstance(e, ast.NumberLit):
+                    return _PostAggColumn(kpos)
             if isinstance(e, ast.FuncCall) and (
                 e.name in _AGG_FUNCS or e.star
             ):
-                key = id(e)
+                # Structural dedup: count(*) in SELECT and HAVING is
+                # ONE aggregate in the reduce (frozen AST nodes hash).
+                key = e
                 if key not in agg_refs:
                     agg_refs[key] = plan_agg(e)
                 idxs = agg_refs[key]
@@ -424,6 +434,29 @@ class QueryPlanner:
                 return ast.UnaryOp(e.op, rewrite(e.expr))
             if isinstance(e, ast.Cast):
                 return ast.Cast(rewrite(e.expr), e.to_type)
+            if isinstance(e, ast.IsNull):
+                return ast.IsNull(rewrite(e.expr), e.negated)
+            if isinstance(e, ast.Extract):
+                return ast.Extract(e.part, rewrite(e.expr))
+            if isinstance(e, ast.InList):
+                return ast.InList(
+                    rewrite(e.expr),
+                    tuple(rewrite(x) for x in e.items),
+                    e.negated,
+                )
+            if isinstance(e, ast.Between):
+                return ast.Between(
+                    rewrite(e.expr), rewrite(e.low), rewrite(e.high),
+                    e.negated,
+                )
+            if isinstance(e, ast.Case):
+                return ast.Case(
+                    rewrite(e.operand) if e.operand is not None else None,
+                    tuple(
+                        (rewrite(c), rewrite(r)) for c, r in e.whens
+                    ),
+                    rewrite(e.else_) if e.else_ is not None else None,
+                )
             if isinstance(e, ast.FuncCall):
                 return ast.FuncCall(
                     e.name, tuple(rewrite(a) for a in e.args), e.distinct
@@ -488,10 +521,18 @@ class QueryPlanner:
         return HLet(bind, red, HUnion((red_get, deflt)))
 
     def _post_agg_scope(self, scope, key_indices, aggs):
-        items = [
-            ScopeItem(scope.items[i].table, scope.items[i].name)
-            for i in key_indices
-        ]
+        items = []
+        for i in key_indices:
+            if i < len(scope.items):
+                items.append(
+                    ScopeItem(scope.items[i].table, scope.items[i].name)
+                )
+            else:
+                # GROUP BY <expression>: the key is a pre-mapped column
+                # beyond the input scope; positionally addressable only.
+                # '#' cannot appear in identifiers, so the name can
+                # never capture a real column reference.
+                items.append(ScopeItem(None, f"#gkey{i}"))
         items += [ScopeItem(None, a.out.name) for a in aggs]
         return Scope(items)
 

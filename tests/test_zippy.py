@@ -1,0 +1,181 @@
+"""Randomized longevity/chaos test: the zippy analog (SURVEY.md §4.3,
+doc/developer/zippy.md): a seeded weighted action loop — DDL, DML,
+generator ticks, coordinator restarts, replica kills — interleaved with
+validation of every maintained view against a host-side model oracle.
+One seed = one deterministic schedule; failures reproduce exactly."""
+
+import os
+import socket
+import threading
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+
+class Model:
+    """Host-side truth: tables as multisets, views as their defining
+    aggregation recomputed from scratch (the validation half of zippy's
+    ValidateView action)."""
+
+    def __init__(self):
+        self.tables: dict[str, list] = {}
+        self.views: dict[str, str] = {}  # view -> source table
+
+    def insert(self, table, rows):
+        self.tables[table].extend(rows)
+
+    def delete_where_ge(self, table, bound):
+        self.tables[table] = [
+            r for r in self.tables[table] if r[0] < bound
+        ]
+
+    def view_result(self, view):
+        table = self.views[view]
+        acc = defaultdict(lambda: [0, 0])
+        for (k, v) in self.tables[table]:
+            acc[k % 4][0] += 1
+            acc[k % 4][1] += v
+        return {
+            (g, n, s): 1 for g, (n, s) in sorted(acc.items()) if n
+        }
+
+
+class TestZippy:
+    @pytest.mark.parametrize("seed", [11, 23])
+    def test_chaos_schedule(self, seed, tmp_path):
+        from materialize_tpu.coord.coordinator import Coordinator
+        from materialize_tpu.coord.protocol import PersistLocation
+        from materialize_tpu.coord.replica import serve_forever
+        from materialize_tpu.storage.persist import (
+            FileBlob,
+            PersistClient,
+            SqliteConsensus,
+        )
+
+        rng = np.random.default_rng(seed)
+        loc = PersistLocation(
+            str(tmp_path / "blob"), str(tmp_path / "consensus.db")
+        )
+
+        replicas = {}
+
+        def start_replica(rid):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            ready = threading.Event()
+            threading.Thread(
+                target=serve_forever,
+                args=(port, loc, rid, ready),
+                daemon=True,
+            ).start()
+            assert ready.wait(10)
+            replicas[rid] = port
+            return port
+
+        def make_coord():
+            c = Coordinator(
+                PersistClient(
+                    FileBlob(loc.blob_root),
+                    SqliteConsensus(loc.consensus_path),
+                ),
+                tick_interval=None,
+            )
+            for rid, port in replicas.items():
+                c.add_replica(rid, ("127.0.0.1", port))
+            return c
+
+        start_replica("r0")
+        coord = make_coord()
+        model = Model()
+        n_tables = 0
+        n_views = 0
+        errors = []
+
+        def act_create_table():
+            nonlocal n_tables
+            name = f"zt{n_tables}"
+            n_tables += 1
+            coord.execute(
+                f"CREATE TABLE {name} (k bigint NOT NULL, v bigint NOT NULL)"
+            )
+            model.tables[name] = []
+
+        def act_insert():
+            if not model.tables:
+                return
+            t = sorted(model.tables)[int(rng.integers(len(model.tables)))]
+            rows = [
+                (int(rng.integers(0, 50)), int(rng.integers(0, 100)))
+                for _ in range(int(rng.integers(1, 5)))
+            ]
+            vals = ", ".join(f"({k}, {v})" for k, v in rows)
+            coord.execute(f"INSERT INTO {t} VALUES {vals}")
+            model.insert(t, rows)
+
+        def act_delete():
+            if not model.tables:
+                return
+            t = sorted(model.tables)[int(rng.integers(len(model.tables)))]
+            bound = int(rng.integers(0, 50))
+            coord.execute(f"DELETE FROM {t} WHERE k >= {bound}")
+            model.delete_where_ge(t, bound)
+
+        def act_create_view():
+            nonlocal n_views
+            if not model.tables:
+                return
+            t = sorted(model.tables)[int(rng.integers(len(model.tables)))]
+            name = f"zv{n_views}"
+            n_views += 1
+            coord.execute(
+                f"CREATE MATERIALIZED VIEW {name} AS "
+                f"SELECT k % 4 AS g, count(*) AS n, sum(v) AS s "
+                f"FROM {t} GROUP BY k % 4"
+            )
+            model.views[name] = t
+
+        def act_restart_coordinator():
+            nonlocal coord
+            coord.shutdown()
+            coord = make_coord()
+
+        def act_add_replica():
+            if len(replicas) < 2:
+                rid = f"r{len(replicas)}"
+                start_replica(rid)
+                coord.add_replica(rid, ("127.0.0.1", replicas[rid]))
+
+        def act_validate():
+            for view in sorted(model.views):
+                res = coord.execute(f"SELECT g, n, s FROM {view}")
+                got = {r: 1 for r in res.rows}
+                want = model.view_result(view)
+                if got != want:
+                    errors.append(
+                        f"view {view}: got {got} want {want}"
+                    )
+
+        actions = [
+            (act_create_table, 1),
+            (act_insert, 8),
+            (act_delete, 3),
+            (act_create_view, 2),
+            (act_restart_coordinator, 1),
+            (act_add_replica, 1),
+            (act_validate, 3),
+        ]
+        weights = np.array([w for _, w in actions], float)
+        weights /= weights.sum()
+
+        act_create_table()
+        act_create_view()
+        for _ in range(40):
+            i = int(rng.choice(len(actions), p=weights))
+            actions[i][0]()
+            assert not errors, errors
+        act_validate()
+        assert not errors, errors
+        coord.shutdown()
