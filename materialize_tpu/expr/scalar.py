@@ -125,6 +125,9 @@ class UnaryFunc:
     CAST_FLOAT64 = "cast_float64"
     # date parts (DATE = days since epoch)
     EXTRACT_YEAR = "extract_year"
+    EXTRACT_MONTH = "extract_month"
+    EXTRACT_DAY = "extract_day"
+    EXTRACT_QUARTER = "extract_quarter"
 
 
 class BinaryFunc:
@@ -162,7 +165,12 @@ class CallUnary(ScalarExpr):
             return Column("f", ColumnType.INT64, inner.nullable)
         if self.func == UnaryFunc.CAST_FLOAT64:
             return Column("f", ColumnType.FLOAT64, inner.nullable)
-        if self.func == UnaryFunc.EXTRACT_YEAR:
+        if self.func in (
+            UnaryFunc.EXTRACT_YEAR,
+            UnaryFunc.EXTRACT_MONTH,
+            UnaryFunc.EXTRACT_DAY,
+            UnaryFunc.EXTRACT_QUARTER,
+        ):
             return Column("f", ColumnType.INT64, inner.nullable)
         return inner  # NEG, ABS preserve type
 
@@ -330,10 +338,21 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
             else:
                 v = e.values.astype(jnp.float64)
             return Evaled(v, e.nulls, col)
-        if f == UnaryFunc.EXTRACT_YEAR:
-            # days-since-epoch -> year; proleptic Gregorian via civil-from-days
-            year = _civil_year_from_days(e.values.astype(jnp.int64))
-            return Evaled(year, e.nulls, col)
+        if f in (
+            UnaryFunc.EXTRACT_YEAR,
+            UnaryFunc.EXTRACT_MONTH,
+            UnaryFunc.EXTRACT_DAY,
+            UnaryFunc.EXTRACT_QUARTER,
+        ):
+            # days-since-epoch -> part; proleptic Gregorian civil_from_days
+            y, m, d = _civil_from_days(e.values.astype(jnp.int64))
+            v = {
+                UnaryFunc.EXTRACT_YEAR: y,
+                UnaryFunc.EXTRACT_MONTH: m,
+                UnaryFunc.EXTRACT_DAY: d,
+                UnaryFunc.EXTRACT_QUARTER: (m + 2) // 3,
+            }[f]
+            return Evaled(v, e.nulls, col)
         raise NotImplementedError(f)
 
     if isinstance(expr, CallBinary):
@@ -484,8 +503,9 @@ def _coerce_comparable(l: Evaled, r: Evaled):
     return l.values, r.values
 
 
-def _civil_year_from_days(days: jnp.ndarray) -> jnp.ndarray:
-    """Howard Hinnant's civil_from_days, vectorized (year only)."""
+def _civil_from_days(days: jnp.ndarray):
+    """Howard Hinnant's civil_from_days, vectorized: (year, month, day)
+    int64 arrays from days-since-epoch (proleptic Gregorian)."""
     z = days + 719468
     era = jnp.where(z >= 0, z, z - 146096) // 146097
     doe = z - era * 146097
@@ -493,8 +513,9 @@ def _civil_year_from_days(days: jnp.ndarray) -> jnp.ndarray:
     y = yoe + era * 400
     doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
     mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
     m = jnp.where(mp < 10, mp + 3, mp - 9)
-    return jnp.where(m <= 2, y + 1, y)
+    return jnp.where(m <= 2, y + 1, y), m, d
 
 
 # Convenience helpers for building expressions in tests/plans.

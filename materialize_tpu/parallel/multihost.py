@@ -1,0 +1,61 @@
+"""Multi-host mesh bootstrap: scaling one replica across TPU hosts.
+
+Analog of the reference's multi-process replicas — one timely instance
+spanning N processes over a TCP mesh with epoch-generation bootstrap
+(``cluster/src/communication.rs:100``). The TPU-native recast rides
+JAX's distributed runtime instead of hand-rolled sockets:
+
+- each replica process on each host calls ``initialize_multihost`` with
+  the same coordinator address and its process index (the analog of
+  ``TimelyConfig.addresses`` + process id,
+  ``cluster-client/src/client.rs:19``);
+- ``jax.distributed.initialize`` forms the global runtime (the "epoch
+  bootstrap" — restarts get fresh coordinator state, preventing the
+  circle-of-doom the reference's generation protocol solves);
+- ``global_worker_mesh`` builds one Mesh over ALL hosts' devices; the
+  per-step ``all_to_all`` exchange then rides ICI within a host/slice
+  and DCN across hosts, inserted by XLA from the same ``shard_map``
+  program that runs single-host (render/dataflow.py ShardedDataflow —
+  no code change, a bigger mesh).
+
+This environment has one chip and no second host, so this module is
+exercised only for its single-process no-op path; the multi-host path
+follows the standard jax.distributed contract.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .mesh import WORKER_AXIS
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int = 1,
+    process_id: int = 0,
+) -> None:
+    """Join the global distributed runtime. No-op for a single process
+    (the common dev path); multi-process requires every process to call
+    this before any backend use."""
+    if num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_worker_mesh(axis: str = WORKER_AXIS):
+    """One 1-D worker mesh over every device of every participating
+    host. Worker = device globally; arrangement shards and exchange
+    routing are host-agnostic (the collectives ride ICI intra-host and
+    DCN inter-host, scheduled by XLA)."""
+    from .mesh import make_mesh
+
+    return make_mesh(axis=axis)
+
+
+def host_local_device_count() -> int:
+    return jax.local_device_count()

@@ -646,10 +646,16 @@ class QueryPlanner:
                 return HCallUnary(UnaryFunc.CAST_FLOAT64, inner)
             raise PlanError(f"unsupported cast to {e.to_type}")
         if isinstance(e, ast.Extract):
-            if e.part != "year":
+            funcs = {
+                "year": UnaryFunc.EXTRACT_YEAR,
+                "month": UnaryFunc.EXTRACT_MONTH,
+                "day": UnaryFunc.EXTRACT_DAY,
+                "quarter": UnaryFunc.EXTRACT_QUARTER,
+            }
+            if e.part not in funcs:
                 raise PlanError(f"EXTRACT({e.part}) unsupported")
             return HCallUnary(
-                UnaryFunc.EXTRACT_YEAR, self.plan_expr(e.expr, scope)
+                funcs[e.part], self.plan_expr(e.expr, scope)
             )
         if isinstance(e, ast.FuncCall):
             if e.name in _AGG_FUNCS or e.star:

@@ -187,3 +187,18 @@ class TestShardedDataflow:
         sdf.step({"in": b})
         rows = sorted(r[:3] for r in sdf.peek())
         assert rows == [(0, int(v.sum()), 200)]
+
+
+class TestMultihost:
+    def test_single_process_bootstrap(self):
+        """The multi-host module's single-process path: no-op init and
+        a global mesh over all local (virtual) devices."""
+        from materialize_tpu.parallel.multihost import (
+            global_worker_mesh,
+            host_local_device_count,
+            initialize_multihost,
+        )
+
+        initialize_multihost()  # num_processes=1: must be a no-op
+        mesh = global_worker_mesh()
+        assert mesh.shape["workers"] == host_local_device_count() == 8
