@@ -542,8 +542,8 @@ class Coordinator:
 
     def _encode_internal(self, schema: Schema, rows: list):
         """Encode DECODED result rows back to device representation:
-        strings re-encode to dictionary codes; decimals are ALREADY
-        internally scaled (unlike _encode_insert's user-value path)."""
+        strings re-encode to dictionary codes; decimals re-scale from the
+        exact decimal.Decimal user value back to the scaled int."""
         cols, nulls = [], []
         for j, col in enumerate(schema.columns):
             vals, mask = [], []
@@ -554,6 +554,8 @@ class Coordinator:
                     vals.append(0)
                 elif col.ctype is ColumnType.STRING:
                     vals.append(GLOBAL_DICT.encode(str(v)))
+                elif col.ctype is ColumnType.DECIMAL and col.scale:
+                    vals.append(int(v * (10 ** col.scale)))
                 else:
                     vals.append(v)
             cols.append(np.asarray(vals, dtype=col.dtype))
@@ -1058,8 +1060,13 @@ class Subscription:
 
 
 def _coerce_internal(v, from_col: Column, to_col: Column):
-    """Coerce an internally-represented value between column types
-    (UPDATE SET expression -> target column)."""
+    """Coerce a USER-SPACE value between column types (UPDATE SET
+    expression -> target column). Rows arrive decoded
+    (decode_result_rows: decimals as decimal.Decimal), and
+    _encode_internal re-scales on the write path, so all arithmetic
+    here is in user space."""
+    import decimal
+
     if v is None:
         if not to_col.nullable:
             raise PlanError(
@@ -1067,20 +1074,21 @@ def _coerce_internal(v, from_col: Column, to_col: Column):
             )
         return None
     if to_col.ctype is ColumnType.DECIMAL:
-        if from_col.ctype is ColumnType.DECIMAL:
-            shift = to_col.scale - from_col.scale
-            return int(v) * 10**shift if shift >= 0 else int(v) // (
-                10 ** (-shift)
-            )
-        return round(float(v) * 10**to_col.scale)
+        q = decimal.Decimal(1).scaleb(-to_col.scale)
+        return decimal.Decimal(str(v)).quantize(
+            q, rounding=decimal.ROUND_HALF_UP
+        )
     if to_col.ctype is ColumnType.FLOAT64:
-        if from_col.ctype is ColumnType.DECIMAL:
-            return float(v) / 10**from_col.scale
         return float(v)
     if to_col.ctype is ColumnType.STRING:
         return str(v)
     if to_col.ctype is ColumnType.BOOL:
         return bool(v)
+    if isinstance(v, decimal.Decimal):
+        # numeric -> integer rounds half away from zero (pg)
+        return int(
+            v.quantize(0, rounding=decimal.ROUND_HALF_UP)
+        )
     return int(v)
 
 

@@ -120,14 +120,91 @@ class UnaryFunc:
     NEG = "neg"
     IS_NULL = "is_null"
     ABS = "abs"
+    # math family (scalar func library analog, expr/src/scalar/func/impls)
+    FLOOR = "floor"
+    CEIL = "ceil"
+    ROUND = "round"
+    TRUNC = "trunc"
+    SQRT = "sqrt"
+    CBRT = "cbrt"
+    EXP = "exp"
+    LN = "ln"
+    LOG10 = "log10"
+    LOG2 = "log2"
+    SIGN = "sign"
+    SIN = "sin"
+    COS = "cos"
+    TAN = "tan"
+    ASIN = "asin"
+    ACOS = "acos"
+    ATAN = "atan"
+    RADIANS = "radians"
+    DEGREES = "degrees"
     # cast family
     CAST_INT64 = "cast_int64"
+    CAST_INT32 = "cast_int32"
     CAST_FLOAT64 = "cast_float64"
-    # date parts (DATE = days since epoch)
+    CAST_BOOL = "cast_bool"
+    CAST_DATE = "cast_date"
+    CAST_TIMESTAMP = "cast_timestamp"
+    # date parts (DATE = days since epoch; TIMESTAMP = ms since epoch)
     EXTRACT_YEAR = "extract_year"
     EXTRACT_MONTH = "extract_month"
     EXTRACT_DAY = "extract_day"
     EXTRACT_QUARTER = "extract_quarter"
+    EXTRACT_DOW = "extract_dow"
+    EXTRACT_ISODOW = "extract_isodow"
+    EXTRACT_DOY = "extract_doy"
+    EXTRACT_WEEK = "extract_week"
+    EXTRACT_EPOCH = "extract_epoch"
+    EXTRACT_HOUR = "extract_hour"
+    EXTRACT_MINUTE = "extract_minute"
+    EXTRACT_SECOND = "extract_second"
+    EXTRACT_MILLENNIUM = "extract_millennium"
+    EXTRACT_CENTURY = "extract_century"
+    EXTRACT_DECADE = "extract_decade"
+    # date_trunc family: value-preserving truncation to a boundary
+    DATE_TRUNC_YEAR = "date_trunc_year"
+    DATE_TRUNC_QUARTER = "date_trunc_quarter"
+    DATE_TRUNC_MONTH = "date_trunc_month"
+    DATE_TRUNC_WEEK = "date_trunc_week"
+    DATE_TRUNC_DAY = "date_trunc_day"
+    DATE_TRUNC_HOUR = "date_trunc_hour"
+    DATE_TRUNC_MINUTE = "date_trunc_minute"
+    DATE_TRUNC_SECOND = "date_trunc_second"
+
+    EXTRACTS = {}  # filled below
+    DATE_TRUNCS = {}  # filled below
+
+
+UnaryFunc.EXTRACTS = {
+    "year": UnaryFunc.EXTRACT_YEAR,
+    "month": UnaryFunc.EXTRACT_MONTH,
+    "day": UnaryFunc.EXTRACT_DAY,
+    "quarter": UnaryFunc.EXTRACT_QUARTER,
+    "dow": UnaryFunc.EXTRACT_DOW,
+    "isodow": UnaryFunc.EXTRACT_ISODOW,
+    "doy": UnaryFunc.EXTRACT_DOY,
+    "week": UnaryFunc.EXTRACT_WEEK,
+    "epoch": UnaryFunc.EXTRACT_EPOCH,
+    "hour": UnaryFunc.EXTRACT_HOUR,
+    "minute": UnaryFunc.EXTRACT_MINUTE,
+    "second": UnaryFunc.EXTRACT_SECOND,
+    "millennium": UnaryFunc.EXTRACT_MILLENNIUM,
+    "century": UnaryFunc.EXTRACT_CENTURY,
+    "decade": UnaryFunc.EXTRACT_DECADE,
+}
+
+UnaryFunc.DATE_TRUNCS = {
+    "year": UnaryFunc.DATE_TRUNC_YEAR,
+    "quarter": UnaryFunc.DATE_TRUNC_QUARTER,
+    "month": UnaryFunc.DATE_TRUNC_MONTH,
+    "week": UnaryFunc.DATE_TRUNC_WEEK,
+    "day": UnaryFunc.DATE_TRUNC_DAY,
+    "hour": UnaryFunc.DATE_TRUNC_HOUR,
+    "minute": UnaryFunc.DATE_TRUNC_MINUTE,
+    "second": UnaryFunc.DATE_TRUNC_SECOND,
+}
 
 
 class BinaryFunc:
@@ -136,6 +213,10 @@ class BinaryFunc:
     MUL = "mul"
     DIV = "div"
     MOD = "mod"
+    POWER = "power"
+    LOG_BASE = "log_base"
+    ROUND_TO = "round_to"  # round(x, n): n must be a literal
+    CAST_DECIMAL = "cast_decimal"  # cast(x as decimal(p, s)): s a literal
     EQ = "eq"
     NEQ = "neq"
     LT = "lt"
@@ -148,6 +229,11 @@ class VariadicFunc:
     AND = "and"
     OR = "or"
     COALESCE = "coalesce"
+    GREATEST = "greatest"
+    LEAST = "least"
+    # (expr, months, days, ms) with literal interval parts; subtraction
+    # negates the parts at plan time
+    ADD_INTERVAL = "add_interval"
 
 
 @dataclass(frozen=True)
@@ -157,21 +243,43 @@ class CallUnary(ScalarExpr):
 
     def typ(self, schema):
         inner = self.expr.typ(schema)
-        if self.func in (UnaryFunc.NOT,):
+        f = self.func
+        if f in (UnaryFunc.NOT,):
             return Column("f", ColumnType.BOOL, inner.nullable)
-        if self.func == UnaryFunc.IS_NULL:
+        if f == UnaryFunc.IS_NULL:
             return Column("f", ColumnType.BOOL, False)
-        if self.func == UnaryFunc.CAST_INT64:
+        if f == UnaryFunc.CAST_INT64:
             return Column("f", ColumnType.INT64, inner.nullable)
-        if self.func == UnaryFunc.CAST_FLOAT64:
+        if f == UnaryFunc.CAST_INT32:
+            return Column("f", ColumnType.INT32, inner.nullable)
+        if f == UnaryFunc.CAST_FLOAT64:
             return Column("f", ColumnType.FLOAT64, inner.nullable)
-        if self.func in (
-            UnaryFunc.EXTRACT_YEAR,
-            UnaryFunc.EXTRACT_MONTH,
-            UnaryFunc.EXTRACT_DAY,
-            UnaryFunc.EXTRACT_QUARTER,
-        ):
+        if f == UnaryFunc.CAST_BOOL:
+            return Column("f", ColumnType.BOOL, inner.nullable)
+        if f == UnaryFunc.CAST_DATE:
+            return Column("f", ColumnType.DATE, inner.nullable)
+        if f == UnaryFunc.CAST_TIMESTAMP:
+            return Column("f", ColumnType.TIMESTAMP, inner.nullable)
+        if f in _EXTRACT_INT_FUNCS:
             return Column("f", ColumnType.INT64, inner.nullable)
+        if f in (UnaryFunc.EXTRACT_EPOCH, UnaryFunc.EXTRACT_SECOND):
+            return Column("f", ColumnType.FLOAT64, inner.nullable)
+        if f in (UnaryFunc.FLOOR, UnaryFunc.CEIL, UnaryFunc.TRUNC,
+                 UnaryFunc.ROUND):
+            # type-preserving on numerics (floor(numeric) is numeric)
+            return inner
+        if f in _FLOAT_UNARY_FUNCS:
+            # domain errors (sqrt of negative, ln of nonpositive) yield
+            # NULL here where the reference raises an EvalError
+            nullable = inner.nullable or f in (
+                UnaryFunc.SQRT, UnaryFunc.LN, UnaryFunc.LOG10,
+                UnaryFunc.LOG2, UnaryFunc.ASIN, UnaryFunc.ACOS,
+            )
+            return Column("f", ColumnType.FLOAT64, nullable)
+        if f == UnaryFunc.SIGN:
+            return Column("f", ColumnType.INT64, inner.nullable)
+        if f in UnaryFunc.DATE_TRUNCS.values():
+            return Column("f", inner.ctype, inner.nullable)
         return inner  # NEG, ABS preserve type
 
 
@@ -193,6 +301,15 @@ class CallBinary(ScalarExpr):
             BinaryFunc.GTE,
         ):
             return Column("f", ColumnType.BOOL, nullable)
+        if self.func in (BinaryFunc.POWER, BinaryFunc.LOG_BASE):
+            return Column("f", ColumnType.FLOAT64, True)
+        if self.func == BinaryFunc.ROUND_TO:
+            return Column("f", lt_.ctype, nullable, lt_.scale)
+        if self.func == BinaryFunc.CAST_DECIMAL:
+            assert isinstance(self.right, Literal)
+            return Column(
+                "f", ColumnType.DECIMAL, lt_.nullable, int(self.right.value)
+            )
         if self.func == BinaryFunc.DIV:
             # SQL: division may produce NULL (div by zero -> error in MZ;
             # we produce NULL for now) and floats for non-decimals.
@@ -221,6 +338,22 @@ class CallVariadic(ScalarExpr):
             first = self.exprs[0].typ(schema)
             nullable = all(e.typ(schema).nullable for e in self.exprs)
             return Column("f", first.ctype, nullable, first.scale)
+        if self.func == VariadicFunc.ADD_INTERVAL:
+            x = self.exprs[0].typ(schema)
+            ms = self.exprs[3]
+            has_ms = not (isinstance(ms, Literal) and ms.value == 0)
+            if x.ctype is ColumnType.DATE and not has_ms:
+                return Column("f", ColumnType.DATE, x.nullable)
+            return Column("f", ColumnType.TIMESTAMP, x.nullable)
+        if self.func in (VariadicFunc.GREATEST, VariadicFunc.LEAST):
+            # unified numeric type; NULL inputs are skipped (pg semantics)
+            typs = [e.typ(schema) for e in self.exprs]
+            out = typs[0]
+            for t in typs[1:]:
+                ctype, scale = _unify_arith(out, t, BinaryFunc.ADD)
+                out = Column("f", ctype, False, scale)
+            nullable = all(t.nullable for t in typs)
+            return Column("f", out.ctype, nullable, out.scale)
         raise NotImplementedError(self.func)
 
 
@@ -230,10 +363,25 @@ class If(ScalarExpr):
     then: ScalarExpr
     els: ScalarExpr
 
+    def _principal(self) -> str:
+        """Which branch determines the result type: an untyped NULL
+        literal defers to the other branch (CASE WHEN c THEN NULL
+        ELSE 1.5 END is float, not int)."""
+        if (
+            isinstance(self.then, Literal)
+            and self.then.value is None
+            and not (
+                isinstance(self.els, Literal) and self.els.value is None
+            )
+        ):
+            return "els"
+        return "then"
+
     def typ(self, schema):
         t = self.then.typ(schema)
         e = self.els.typ(schema)
-        return Column("f", t.ctype, t.nullable or e.nullable, t.scale)
+        p = t if self._principal() == "then" else e
+        return Column("f", p.ctype, t.nullable or e.nullable, p.scale)
 
 
 def _unify_arith(lt_: Column, rt: Column, func: str) -> tuple[ColumnType, int]:
@@ -338,20 +486,75 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
             else:
                 v = e.values.astype(jnp.float64)
             return Evaled(v, e.nulls, col)
-        if f in (
-            UnaryFunc.EXTRACT_YEAR,
-            UnaryFunc.EXTRACT_MONTH,
-            UnaryFunc.EXTRACT_DAY,
-            UnaryFunc.EXTRACT_QUARTER,
+        if f == UnaryFunc.CAST_INT32:
+            if e.col.ctype is ColumnType.DECIMAL:
+                v = (e.values // (10**e.col.scale)).astype(jnp.int32)
+            else:
+                v = e.values.astype(jnp.int32)
+            return Evaled(v, e.nulls, col)
+        if f == UnaryFunc.CAST_BOOL:
+            return Evaled(e.values != 0, e.nulls, col)
+        if f == UnaryFunc.CAST_DATE:
+            if e.col.ctype is ColumnType.TIMESTAMP:
+                v = (e.values.astype(jnp.int64) // _MS_PER_DAY).astype(
+                    jnp.int32
+                )
+            else:
+                v = e.values.astype(jnp.int32)
+            return Evaled(v, e.nulls, col)
+        if f == UnaryFunc.CAST_TIMESTAMP:
+            if e.col.ctype is ColumnType.DATE:
+                v = e.values.astype(jnp.int64) * _MS_PER_DAY
+            else:
+                v = e.values.astype(jnp.int64)
+            return Evaled(v, e.nulls, col)
+        if f in _EXTRACT_INT_FUNCS or f in (
+            UnaryFunc.EXTRACT_EPOCH,
+            UnaryFunc.EXTRACT_SECOND,
         ):
-            # days-since-epoch -> part; proleptic Gregorian civil_from_days
-            y, m, d = _civil_from_days(e.values.astype(jnp.int64))
-            v = {
-                UnaryFunc.EXTRACT_YEAR: y,
-                UnaryFunc.EXTRACT_MONTH: m,
-                UnaryFunc.EXTRACT_DAY: d,
-                UnaryFunc.EXTRACT_QUARTER: (m + 2) // 3,
+            return _eval_extract(f, e, col)
+        if f in UnaryFunc.DATE_TRUNCS.values():
+            return _eval_date_trunc(f, e, col)
+        if f in (UnaryFunc.FLOOR, UnaryFunc.CEIL, UnaryFunc.TRUNC,
+                 UnaryFunc.ROUND):
+            return _eval_round_family(f, e, col)
+        if f in _FLOAT_UNARY_FUNCS:
+            x = _as_float(e)
+            if f == UnaryFunc.SQRT:
+                bad = x < 0.0
+                v = jnp.sqrt(jnp.where(bad, 0.0, x))
+                return Evaled(v, _or_nulls(e.nulls, bad), col)
+            if f in (UnaryFunc.LN, UnaryFunc.LOG10, UnaryFunc.LOG2):
+                bad = x <= 0.0
+                safe = jnp.where(bad, 1.0, x)
+                v = {
+                    UnaryFunc.LN: jnp.log,
+                    UnaryFunc.LOG10: lambda a: jnp.log(a)
+                    / jnp.log(10.0),
+                    UnaryFunc.LOG2: jnp.log2,
+                }[f](safe)
+                return Evaled(v, _or_nulls(e.nulls, bad), col)
+            if f in (UnaryFunc.ASIN, UnaryFunc.ACOS):
+                bad = jnp.abs(x) > 1.0
+                safe = jnp.where(bad, 0.0, x)
+                op = jnp.arcsin if f == UnaryFunc.ASIN else jnp.arccos
+                return Evaled(op(safe), _or_nulls(e.nulls, bad), col)
+            op = {
+                UnaryFunc.CBRT: jnp.cbrt,
+                UnaryFunc.EXP: jnp.exp,
+                UnaryFunc.SIN: jnp.sin,
+                UnaryFunc.COS: jnp.cos,
+                UnaryFunc.TAN: jnp.tan,
+                UnaryFunc.ATAN: jnp.arctan,
+                UnaryFunc.RADIANS: jnp.radians,
+                UnaryFunc.DEGREES: jnp.degrees,
             }[f]
+            return Evaled(op(x), e.nulls, col)
+        if f == UnaryFunc.SIGN:
+            v = jnp.sign(
+                _as_float(e) if e.col.ctype is ColumnType.FLOAT64
+                else e.values
+            ).astype(jnp.int64)
             return Evaled(v, e.nulls, col)
         raise NotImplementedError(f)
 
@@ -412,6 +615,54 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
             zero = r.values == 0
             v = jnp.where(zero, 0, l.values % jnp.where(zero, 1, r.values))
             return Evaled(v, _or_nulls(nulls, zero), col)
+        if f == BinaryFunc.POWER:
+            lv, rv = _as_float(l), _as_float(r)
+            v = jnp.power(lv, rv)
+            bad = jnp.isnan(v) | jnp.isinf(v)
+            return Evaled(
+                jnp.where(bad, 0.0, v), _or_nulls(nulls, bad), col
+            )
+        if f == BinaryFunc.LOG_BASE:
+            b, x = _as_float(l), _as_float(r)
+            bad = (b <= 0.0) | (b == 1.0) | (x <= 0.0)
+            v = jnp.log(jnp.where(bad, 2.0, x)) / jnp.log(
+                jnp.where(bad, 2.0, b)
+            )
+            return Evaled(v, _or_nulls(nulls, bad), col)
+        if f == BinaryFunc.CAST_DECIMAL:
+            scale = col.scale
+            if l.col.ctype is ColumnType.FLOAT64:
+                v = jnp.round(l.values * (10.0**scale)).astype(jnp.int64)
+            elif (
+                l.col.ctype is ColumnType.DECIMAL and l.col.scale > scale
+            ):
+                # narrowing rescale rounds half away from zero (pg numeric)
+                v = _round_half_away(
+                    l.values, 10 ** (l.col.scale - scale), rescale=True
+                )
+            else:
+                v = _to_decimal_scale(l, scale)
+            return Evaled(v, l.nulls, col)
+        if f == BinaryFunc.ROUND_TO:
+            if not isinstance(expr.right, Literal):
+                raise NotImplementedError("round(x, n): n must be a literal")
+            n = int(expr.right.value)
+            if l.col.ctype is ColumnType.FLOAT64:
+                factor = 10.0**n
+                v = jnp.round(l.values * factor) / factor
+                return Evaled(v, nulls, col)
+            if l.col.ctype is ColumnType.DECIMAL:
+                drop = l.col.scale - n
+                if drop <= 0:
+                    return Evaled(l.values, nulls, col)
+                v = _round_half_away(l.values, 10**drop)
+                return Evaled(v, nulls, col)
+            if n < 0:  # integers: round(123, -1) = 120 (pg numeric)
+                v = _round_half_away(
+                    l.values.astype(jnp.int64), 10 ** (-n)
+                ).astype(l.values.dtype)
+                return Evaled(v, nulls, col)
+            return Evaled(l.values, nulls, col)
         raise NotImplementedError(f)
 
     if isinstance(expr, CallVariadic):
@@ -457,6 +708,56 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
                 out_v = jnp.where(take, p.values, out_v)
                 out_n = jnp.where(take, jnp.zeros_like(out_n), out_n)
             return Evaled(out_v, out_n, col)
+        if expr.func == VariadicFunc.ADD_INTERVAL:
+            e = parts[0]
+            months, days, ms = (
+                int(x.value) for x in expr.exprs[1:]  # plan-time literals
+            )
+            dd, msod = _days_and_ms(e)
+            if months:
+                y, m, d = _civil_from_days(dd)
+                m0 = m - 1 + months
+                y2 = y + m0 // 12
+                m2 = m0 % 12 + 1
+                # clamp to the target month's last day (pg semantics)
+                next_month_start = _days_from_civil(
+                    y2 + (m2 == 12), jnp.where(m2 == 12, 1, m2 + 1),
+                    jnp.ones_like(m2),
+                )
+                month_len = next_month_start - _days_from_civil(
+                    y2, m2, jnp.ones_like(m2)
+                )
+                d2 = jnp.minimum(d, month_len)
+                dd = _days_from_civil(y2, m2, d2)
+            dd = dd + days
+            if col.ctype is ColumnType.DATE:
+                return Evaled(dd.astype(col.dtype), e.nulls, col)
+            return Evaled(dd * _MS_PER_DAY + msod + ms, e.nulls, col)
+        if expr.func in (VariadicFunc.GREATEST, VariadicFunc.LEAST):
+            # pg semantics: NULL arguments are ignored; result is NULL
+            # only when every argument is NULL
+            if col.ctype is ColumnType.FLOAT64:
+                coerced = [_as_float(p) for p in parts]
+            elif col.ctype is ColumnType.DECIMAL:
+                coerced = [_to_decimal_scale(p, col.scale) for p in parts]
+            else:
+                coerced = [p.values.astype(col.dtype) for p in parts]
+            better = (
+                jnp.greater
+                if expr.func == VariadicFunc.GREATEST
+                else jnp.less
+            )
+            acc_v = coerced[0]
+            acc_n = parts[0].null_mask()
+            for p, v in zip(parts[1:], coerced[1:]):
+                pn = p.null_mask()
+                take = jnp.logical_and(
+                    jnp.logical_not(pn),
+                    jnp.logical_or(acc_n, better(v, acc_v)),
+                )
+                acc_v = jnp.where(take, v, acc_v)
+                acc_n = jnp.logical_and(acc_n, pn)
+            return Evaled(acc_v, acc_n, col)
         raise NotImplementedError(expr.func)
 
     if isinstance(expr, If):
@@ -465,7 +766,16 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
         e = eval_expr(expr.els, batch, time)
         col = expr.typ(schema)
         cond = jnp.logical_and(c.values, jnp.logical_not(c.null_mask()))
-        vals = jnp.where(cond, t.values, e.values)
+        tv, ev = t.values, e.values
+        # branches of different device dtypes (an untyped NULL literal):
+        # the principal branch (If.typ) defines the type; the NULL
+        # branch's zeros are cast to it (values there are masked anyway)
+        if ev.dtype != tv.dtype:
+            if expr._principal() == "then":
+                ev = ev.astype(tv.dtype)
+            else:
+                tv = tv.astype(ev.dtype)
+        vals = jnp.where(cond, tv, ev)
         nulls = jnp.where(cond, t.null_mask(), e.null_mask())
         return Evaled(vals, nulls, col)
 
@@ -482,6 +792,16 @@ def _or_nulls(nulls, extra):
     if nulls is None:
         return extra
     return jnp.logical_or(nulls, extra)
+
+
+def _round_half_away(v: jnp.ndarray, step: int, rescale: bool = False):
+    """Round a scaled integer to a multiple of ``step``, half away from
+    zero (pg numeric). ``rescale`` divides the result by step (narrowing
+    a decimal's scale) instead of keeping the original scale."""
+    mag = (jnp.abs(v) + step // 2) // step
+    if not rescale:
+        mag = mag * step
+    return jnp.sign(v) * mag
 
 
 def _as_float(e: Evaled) -> jnp.ndarray:
@@ -516,6 +836,168 @@ def _civil_from_days(days: jnp.ndarray):
     d = doy - (153 * mp + 2) // 5 + 1
     m = jnp.where(mp < 10, mp + 3, mp - 9)
     return jnp.where(m <= 2, y + 1, y), m, d
+
+
+def _days_from_civil(y, m, d):
+    """Inverse of _civil_from_days, vectorized (proleptic Gregorian)."""
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+_EXTRACT_INT_FUNCS = frozenset(
+    {
+        UnaryFunc.EXTRACT_YEAR,
+        UnaryFunc.EXTRACT_MONTH,
+        UnaryFunc.EXTRACT_DAY,
+        UnaryFunc.EXTRACT_QUARTER,
+        UnaryFunc.EXTRACT_DOW,
+        UnaryFunc.EXTRACT_ISODOW,
+        UnaryFunc.EXTRACT_DOY,
+        UnaryFunc.EXTRACT_WEEK,
+        UnaryFunc.EXTRACT_HOUR,
+        UnaryFunc.EXTRACT_MINUTE,
+        UnaryFunc.EXTRACT_MILLENNIUM,
+        UnaryFunc.EXTRACT_CENTURY,
+        UnaryFunc.EXTRACT_DECADE,
+    }
+)
+
+_FLOAT_UNARY_FUNCS = frozenset(
+    {
+        UnaryFunc.SQRT,
+        UnaryFunc.CBRT,
+        UnaryFunc.EXP,
+        UnaryFunc.LN,
+        UnaryFunc.LOG10,
+        UnaryFunc.LOG2,
+        UnaryFunc.SIN,
+        UnaryFunc.COS,
+        UnaryFunc.TAN,
+        UnaryFunc.ASIN,
+        UnaryFunc.ACOS,
+        UnaryFunc.ATAN,
+        UnaryFunc.RADIANS,
+        UnaryFunc.DEGREES,
+    }
+)
+
+_MS_PER_DAY = 86_400_000
+
+
+def _days_and_ms(e: Evaled):
+    """(days-since-epoch, ms-of-day) for a DATE or TIMESTAMP input."""
+    if e.col.ctype is ColumnType.TIMESTAMP:
+        ms = e.values.astype(jnp.int64)
+        return ms // _MS_PER_DAY, ms % _MS_PER_DAY
+    return e.values.astype(jnp.int64), jnp.zeros_like(
+        e.values, dtype=jnp.int64
+    )
+
+
+def _eval_extract(f: str, e: Evaled, col: Column) -> Evaled:
+    days, msod = _days_and_ms(e)
+    if f == UnaryFunc.EXTRACT_EPOCH:
+        if e.col.ctype is ColumnType.TIMESTAMP:
+            v = e.values.astype(jnp.float64) / 1000.0
+        else:
+            v = days.astype(jnp.float64) * 86400.0
+        return Evaled(v, e.nulls, col)
+    if f == UnaryFunc.EXTRACT_HOUR:
+        return Evaled(msod // 3_600_000, e.nulls, col)
+    if f == UnaryFunc.EXTRACT_MINUTE:
+        return Evaled((msod // 60_000) % 60, e.nulls, col)
+    if f == UnaryFunc.EXTRACT_SECOND:
+        v = (msod % 60_000).astype(jnp.float64) / 1000.0
+        return Evaled(v, e.nulls, col)
+    if f == UnaryFunc.EXTRACT_DOW:
+        # pg: Sunday=0..Saturday=6; 1970-01-01 was a Thursday
+        return Evaled((days + 4) % 7, e.nulls, col)
+    if f == UnaryFunc.EXTRACT_ISODOW:
+        return Evaled((days + 3) % 7 + 1, e.nulls, col)
+    y, m, d = _civil_from_days(days)
+    if f == UnaryFunc.EXTRACT_YEAR:
+        return Evaled(y, e.nulls, col)
+    if f == UnaryFunc.EXTRACT_MONTH:
+        return Evaled(m, e.nulls, col)
+    if f == UnaryFunc.EXTRACT_DAY:
+        return Evaled(d, e.nulls, col)
+    if f == UnaryFunc.EXTRACT_QUARTER:
+        return Evaled((m + 2) // 3, e.nulls, col)
+    if f == UnaryFunc.EXTRACT_DOY:
+        return Evaled(days - _days_from_civil(y, 1, 1) + 1, e.nulls, col)
+    if f == UnaryFunc.EXTRACT_WEEK:
+        # ISO 8601 week: the week containing this date's Thursday
+        thursday = days + (3 - (days + 3) % 7)
+        ty, _, _ = _civil_from_days(thursday)
+        week = (thursday - _days_from_civil(ty, 1, 1)) // 7 + 1
+        return Evaled(week, e.nulls, col)
+    if f == UnaryFunc.EXTRACT_MILLENNIUM:
+        return Evaled((y - 1) // 1000 + 1, e.nulls, col)
+    if f == UnaryFunc.EXTRACT_CENTURY:
+        return Evaled((y - 1) // 100 + 1, e.nulls, col)
+    if f == UnaryFunc.EXTRACT_DECADE:
+        return Evaled(y // 10, e.nulls, col)
+    raise NotImplementedError(f)
+
+
+def _eval_date_trunc(f: str, e: Evaled, col: Column) -> Evaled:
+    days, msod = _days_and_ms(e)
+    T = UnaryFunc
+    if f in (T.DATE_TRUNC_YEAR, T.DATE_TRUNC_QUARTER, T.DATE_TRUNC_MONTH):
+        y, m, _ = _civil_from_days(days)
+        if f == T.DATE_TRUNC_YEAR:
+            tdays = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(m))
+        elif f == T.DATE_TRUNC_QUARTER:
+            qm = 3 * ((m - 1) // 3) + 1
+            tdays = _days_from_civil(y, qm, jnp.ones_like(m))
+        else:
+            tdays = _days_from_civil(y, m, jnp.ones_like(m))
+        tmsod = jnp.zeros_like(msod)
+    elif f == T.DATE_TRUNC_WEEK:
+        tdays = days - (days + 3) % 7  # back to Monday
+        tmsod = jnp.zeros_like(msod)
+    elif f == T.DATE_TRUNC_DAY:
+        tdays, tmsod = days, jnp.zeros_like(msod)
+    else:
+        step = {
+            T.DATE_TRUNC_HOUR: 3_600_000,
+            T.DATE_TRUNC_MINUTE: 60_000,
+            T.DATE_TRUNC_SECOND: 1_000,
+        }[f]
+        tdays, tmsod = days, msod - msod % step
+    if e.col.ctype is ColumnType.TIMESTAMP:
+        return Evaled(tdays * _MS_PER_DAY + tmsod, e.nulls, col)
+    return Evaled(tdays.astype(e.values.dtype), e.nulls, col)
+
+
+def _eval_round_family(f: str, e: Evaled, col: Column) -> Evaled:
+    T = UnaryFunc
+    if e.col.ctype is ColumnType.FLOAT64:
+        op = {
+            T.FLOOR: jnp.floor,
+            T.CEIL: jnp.ceil,
+            T.TRUNC: jnp.trunc,
+            T.ROUND: jnp.round,  # half-even, like pg float8
+        }[f]
+        return Evaled(op(e.values), e.nulls, col)
+    if e.col.ctype is ColumnType.DECIMAL and e.col.scale > 0:
+        step = 10**e.col.scale
+        v = e.values
+        if f == T.FLOOR:
+            out = (v // step) * step
+        elif f == T.CEIL:
+            out = -((-v) // step) * step
+        elif f == T.TRUNC:
+            out = jnp.where(v >= 0, v // step, -((-v) // step)) * step
+        else:  # ROUND: half away from zero, like pg numeric
+            out = _round_half_away(v, step)
+        return Evaled(out, e.nulls, col)
+    return Evaled(e.values, e.nulls, col)  # integers unchanged
 
 
 # Convenience helpers for building expressions in tests/plans.

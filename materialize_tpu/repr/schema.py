@@ -171,6 +171,8 @@ def decode_result_rows(schema: Schema, cols, nulls, time, diff) -> list:
     Codes are PROCESS-LOCAL, so every surface that hands rows across a
     process boundary (peek responses, SUBSCRIBE events) must decode
     through this one helper."""
+    import decimal as _dec
+
     out = []
     for i in range(len(diff)):
         vals = []
@@ -179,6 +181,13 @@ def decode_result_rows(schema: Schema, cols, nulls, time, diff) -> list:
                 vals.append(None)
             elif col.ctype is ColumnType.STRING:
                 vals.append(GLOBAL_DICT.decode(int(cols[j][i])))
+            elif col.ctype is ColumnType.DECIMAL and col.scale:
+                # scaled int -> exact decimal (the user-facing value;
+                # _encode_internal re-scales on the way back in)
+                vals.append(
+                    _dec.Decimal(int(cols[j][i]))
+                    / (10 ** col.scale)
+                )
             else:
                 vals.append(cols[j][i].item())
         out.append(tuple(vals) + (int(time[i]), int(diff[i])))

@@ -121,7 +121,11 @@ def make_handler(coordinator):
                         )
                     else:
                         results.append({"tag": "OK"})
-                body = json.dumps({"results": results}).encode()
+                # default=str: exact decimal.Decimal values serialize as
+                # their text form (pg's numeric-over-json behavior)
+                body = json.dumps(
+                    {"results": results}, default=str
+                ).encode()
                 self._reply(200, body, "application/json")
             except Exception as e:
                 from ..sql.hir import PlanError

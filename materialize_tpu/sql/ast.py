@@ -37,6 +37,15 @@ class StringLit(Expr):
 
 
 @dataclass(frozen=True)
+class IntervalLit(Expr):
+    """INTERVAL '...' literal, normalized to (months, days, ms)."""
+
+    months: int = 0
+    days: int = 0
+    ms: int = 0
+
+
+@dataclass(frozen=True)
 class BoolLit(Expr):
     value: bool
 

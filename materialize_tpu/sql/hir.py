@@ -354,6 +354,30 @@ def type_from_name(name: str) -> ColumnType:
         raise PlanError(f"unknown type {name!r}") from None
 
 
+def parse_type(name: str) -> tuple:
+    """'decimal(12,2)' -> (ColumnType.DECIMAL, 2); the single home of
+    type-name parameter parsing (precision is accepted and ignored —
+    decimals are scaled int64)."""
+    t = name.strip().lower()
+    base, args = t, []
+    if "(" in t:
+        if ")" not in t:
+            raise PlanError(f"malformed type name {name!r}")
+        base = t[: t.index("(")].strip()
+        args = [
+            a.strip()
+            for a in t[t.index("(") + 1 : t.rindex(")")].split(",")
+        ]
+    ty = type_from_name(base)
+    scale = 0
+    if ty is ColumnType.DECIMAL and len(args) > 1:
+        try:
+            scale = int(args[1])
+        except ValueError:
+            raise PlanError(f"malformed type name {name!r}") from None
+    return ty, scale
+
+
 # -- typing HIR scalars ------------------------------------------------------
 
 from ..expr import scalar as mscalar

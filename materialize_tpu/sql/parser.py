@@ -39,8 +39,9 @@ class Parser:
         self.i = 0
 
     # -- token helpers -----------------------------------------------------
-    def peek(self) -> Token:
-        return self.toks[self.i]
+    def peek(self, ahead: int = 0) -> Token:
+        j = self.i + ahead
+        return self.toks[min(j, len(self.toks) - 1)]
 
     def next(self) -> Token:
         t = self.toks[self.i]
@@ -664,7 +665,29 @@ class Parser:
         if t.kind is TokKind.STRING:
             self.next()
             return self._postfix(ast.StringLit(t.text))
+        if self.accept_kw("interval"):
+            s = self.peek()
+            if s.kind is not TokKind.STRING:
+                raise ParseError("INTERVAL requires a string literal")
+            self.next()
+            unit = None
+            u = self.peek()
+            if u.kind in (TokKind.IDENT, TokKind.KEYWORD) and (
+                u.text.lower().rstrip("s") in _INTERVAL_UNITS
+            ):
+                unit = u.text.lower().rstrip("s")
+                self.next()
+            return _interval_literal(s.text, unit)
         if t.kind in (TokKind.IDENT, TokKind.KEYWORD):
+            # typed literal (DATE '1994-01-01', TIMESTAMP '...')
+            if t.text.lower() in ("date", "timestamp") and (
+                self.peek(1).kind is TokKind.STRING
+            ):
+                ty = t.text.lower()
+                self.next()
+                s = self.peek()
+                self.next()
+                return self._postfix(ast.Cast(ast.StringLit(s.text), ty))
             # function call or (qualified) column reference
             name = self.expect_ident()
             if self.accept_sym("("):
@@ -691,6 +714,97 @@ class Parser:
         while self.accept_sym("::"):
             e = ast.Cast(e, self._type_name())
         return e
+
+
+_INTERVAL_UNITS = {
+    "year": ("months", 12),
+    "quarter": ("months", 3),
+    "month": ("months", 1),
+    "week": ("days", 7),
+    "day": ("days", 1),
+    "hour": ("ms", 3_600_000),
+    "minute": ("ms", 60_000),
+    "second": ("ms", 1_000),
+    "millisecond": ("ms", 1),
+}
+
+
+def _interval_literal(text: str, unit: str | None) -> ast.IntervalLit:
+    """INTERVAL '1' YEAR / INTERVAL '3 months' / INTERVAL '1 day 2:30'
+    -> normalized (months, days, ms), like the reference's interval
+    parsing (repr/src/adt/interval.rs). Bare numbers are SECONDS and
+    H[:M[:S]] groups are time-of-day, both as in pg; fractional months
+    spill into days (30/month) and fractional days into ms."""
+    monthsf = daysf = 0.0
+    msf = 0.0
+
+    def add(qty: float, u: str) -> None:
+        nonlocal monthsf, daysf, msf
+        field, mult = _INTERVAL_UNITS[u]
+        if field == "months":
+            monthsf += qty * mult
+        elif field == "days":
+            daysf += qty * mult
+        else:
+            msf += qty * mult
+
+    def num(word: str) -> float:
+        try:
+            return float(word)
+        except ValueError:
+            raise ParseError(
+                f"bad interval literal {text!r}"
+            ) from None
+
+    def add_clock(word: str) -> None:
+        nonlocal msf
+        segs = word.split(":")
+        if len(segs) not in (2, 3) or not segs[0]:
+            raise ParseError(f"bad interval literal {text!r}")
+        sign = -1 if segs[0].lstrip().startswith("-") else 1
+        h = abs(num(segs[0]))
+        m = num(segs[1])
+        s = num(segs[2]) if len(segs) == 3 else 0.0
+        msf += sign * (h * 3_600_000 + m * 60_000 + s * 1_000)
+
+    words = text.strip().split()
+    if not words:
+        raise ParseError(f"bad interval literal {text!r}")
+    if unit is not None:
+        if len(words) != 1:
+            raise ParseError(f"bad interval literal {text!r}")
+        add(num(words[0]), unit)
+    else:
+        i = 0
+        while i < len(words):
+            w = words[i]
+            if ":" in w:
+                add_clock(w)
+                i += 1
+                continue
+            qty = num(w)
+            if i + 1 < len(words):
+                if ":" in words[i + 1]:
+                    # pg day-then-clock shorthand: '1 2:30' = 1 day 02:30
+                    daysf += qty
+                    i += 1
+                    continue
+                u = words[i + 1].lower().rstrip("s")
+                if u not in _INTERVAL_UNITS:
+                    raise ParseError(
+                        f"unknown interval unit {words[i + 1]!r}"
+                    )
+                add(qty, u)
+                i += 2
+            else:
+                msf += qty * 1_000  # bare number: seconds (pg)
+                i += 1
+    # spill fractional months -> days (30/month), days -> ms
+    months = int(monthsf)
+    daysf += (monthsf - months) * 30
+    days = int(daysf)
+    msf += (daysf - days) * 86_400_000
+    return ast.IntervalLit(months, days, int(round(msf)))
 
 
 def parse_statement(sql: str) -> ast.Statement:

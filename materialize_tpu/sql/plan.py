@@ -190,23 +190,14 @@ def _plan(stmt: ast.Statement, catalog: CatalogInterface) -> Plan:
 
 
 def _table_schema(columns) -> Schema:
-    """CREATE TABLE column list -> Schema; the type-name mapping is the
-    planner's (hir.type_from_name) — only decimal(p,s) scale parsing
-    lives here."""
+    """CREATE TABLE column list -> Schema (type parsing: hir.parse_type)."""
     from ..repr.schema import Column
-    from .hir import type_from_name
+    from .hir import parse_type
 
     cols = []
     for name, type_name, nullable in columns:
-        t = type_name.lower()
-        scale = 0
-        base = t
-        if "(" in t:
-            base = t[: t.index("(")]
-            args = t[t.index("(") + 1 : t.rindex(")")].split(",")
-            if base in ("decimal", "numeric") and len(args) > 1:
-                scale = int(args[1])
-        cols.append(Column(name, type_from_name(base), nullable, scale))
+        ty, scale = parse_type(type_name)
+        cols.append(Column(name, ty, nullable, scale))
     return Schema(cols)
 
 

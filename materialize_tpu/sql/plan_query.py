@@ -49,6 +49,51 @@ from .hir import (
     typ_of,
 )
 
+_UNARY_FUNC_NAMES = {
+    "abs": UnaryFunc.ABS,
+    "floor": UnaryFunc.FLOOR,
+    "ceil": UnaryFunc.CEIL,
+    "ceiling": UnaryFunc.CEIL,
+    "trunc": UnaryFunc.TRUNC,
+    "sqrt": UnaryFunc.SQRT,
+    "cbrt": UnaryFunc.CBRT,
+    "exp": UnaryFunc.EXP,
+    "ln": UnaryFunc.LN,
+    "log2": UnaryFunc.LOG2,
+    "log10": UnaryFunc.LOG10,
+    "sign": UnaryFunc.SIGN,
+    "sin": UnaryFunc.SIN,
+    "cos": UnaryFunc.COS,
+    "tan": UnaryFunc.TAN,
+    "asin": UnaryFunc.ASIN,
+    "acos": UnaryFunc.ACOS,
+    "atan": UnaryFunc.ATAN,
+    "radians": UnaryFunc.RADIANS,
+    "degrees": UnaryFunc.DEGREES,
+}
+
+
+def _parse_datetime_literal(text: str, ty: ColumnType) -> int:
+    """'1994-01-01' -> days since epoch; with a time part -> ms since
+    epoch. Plan-time analog of the reference's string-to-date casts."""
+    import datetime as _dt
+
+    s = text.strip()
+    try:
+        if ty is ColumnType.DATE:
+            d = _dt.date.fromisoformat(s)
+            return (d - _dt.date(1970, 1, 1)).days
+        if " " in s or "T" in s:
+            dt = _dt.datetime.fromisoformat(s.replace("T", " "))
+        else:
+            d = _dt.date.fromisoformat(s)
+            dt = _dt.datetime(d.year, d.month, d.day)
+        epoch = _dt.datetime(1970, 1, 1)
+        return int((dt - epoch).total_seconds() * 1000)
+    except ValueError as exc:
+        raise PlanError(f"invalid {ty.value} literal {text!r}") from exc
+
+
 _BINOPS = {
     "+": BinaryFunc.ADD,
     "-": BinaryFunc.SUB,
@@ -63,7 +108,18 @@ _BINOPS = {
     ">=": BinaryFunc.GTE,
 }
 
-_AGG_FUNCS = {"count", "sum", "min", "max", "avg"}
+_VAR_AGGS = {
+    "stddev",
+    "stddev_samp",
+    "stddev_pop",
+    "variance",
+    "var_samp",
+    "var_pop",
+}
+_AGG_FUNCS = (
+    {"count", "sum", "min", "max", "avg", "bool_and", "bool_or", "every"}
+    | _VAR_AGGS
+)
 
 
 def _number_literal(text: str) -> HLiteral:
@@ -362,42 +418,75 @@ class QueryPlanner:
         # 2. collect aggregate calls from items + having
         aggs: list[HAggregate] = []
 
-        def plan_agg(fc: ast.FuncCall) -> list:
-            """Returns [(kind, agg_index)] — avg yields sum+count."""
+        def plan_agg(fc: ast.FuncCall) -> tuple:
+            """Returns (kind, [agg indices]) — composite aggregates
+            (avg, stddev/variance) decompose into sums and counts, like
+            the reference's sql func library (sql/src/func.rs)."""
             name = fc.name
+            dist = fc.distinct
             if fc.star or (name == "count" and not fc.args):
                 inner = HLiteral(True, ColumnType.BOOL)
             else:
                 inner = self.plan_expr(fc.args[0], scope)
             ityp = typ_of(inner, schema)
-            if fc.distinct:
-                raise NotImplementedError("DISTINCT aggregates")
             if name == "count":
                 func, out = AggregateFunc.COUNT, Column(
                     "count", ColumnType.INT64, False
                 )
-                aggs.append(HAggregate(func, inner, False, out))
-                return [len(aggs) - 1]
+                aggs.append(HAggregate(func, inner, dist, out))
+                return ("plain", [len(aggs) - 1])
             if name == "sum":
                 if ityp.ctype is ColumnType.FLOAT64:
                     func = AggregateFunc.SUM_FLOAT
                     out = Column("sum", ColumnType.FLOAT64, True)
+                elif ityp.ctype is ColumnType.BOOL:
+                    raise PlanError("sum over boolean is not defined")
                 else:
                     func = AggregateFunc.SUM_INT
                     out = Column("sum", ityp.ctype, True, ityp.scale)
-                aggs.append(HAggregate(func, inner, False, out))
-                return [len(aggs) - 1]
+                aggs.append(HAggregate(func, inner, dist, out))
+                return ("plain", [len(aggs) - 1])
             if name in ("min", "max"):
                 func = (
                     AggregateFunc.MIN if name == "min" else AggregateFunc.MAX
                 )
                 out = Column(name, ityp.ctype, True, ityp.scale)
                 aggs.append(HAggregate(func, inner, False, out))
-                return [len(aggs) - 1]
+                return ("plain", [len(aggs) - 1])
+            if name in ("bool_and", "every", "bool_or"):
+                if ityp.ctype is not ColumnType.BOOL:
+                    raise PlanError(f"{name} requires a boolean argument")
+                func = (
+                    AggregateFunc.ANY
+                    if name == "bool_or"
+                    else AggregateFunc.ALL
+                )
+                out = Column(name, ColumnType.BOOL, True)
+                aggs.append(HAggregate(func, inner, False, out))
+                return ("plain", [len(aggs) - 1])
             if name == "avg":
-                s = plan_agg(ast.FuncCall("sum", fc.args))
-                c = plan_agg(ast.FuncCall("count", fc.args))
-                return s + c
+                _, s = plan_agg(
+                    ast.FuncCall("sum", fc.args, distinct=dist)
+                )
+                _, c = plan_agg(
+                    ast.FuncCall("count", fc.args, distinct=dist)
+                )
+                return ("avg", s + c)
+            if name in _VAR_AGGS:
+                if dist:
+                    # sum(DISTINCT x*x) dedups on x*x, not on x, so the
+                    # decomposition would be wrong for {-a, a} inputs
+                    raise PlanError(
+                        f"{name}(DISTINCT ...) is not supported"
+                    )
+                dbl = ast.Cast(fc.args[0], "double")
+                sq = ast.BinaryOp("*", dbl, dbl)
+                _, s = plan_agg(ast.FuncCall("sum", (dbl,), distinct=dist))
+                _, ss = plan_agg(ast.FuncCall("sum", (sq,), distinct=dist))
+                _, c = plan_agg(
+                    ast.FuncCall("count", (dbl,), distinct=dist)
+                )
+                return (name, s + ss + c)
             raise PlanError(f"unknown aggregate {name}")
 
         n_key = len(key_indices)
@@ -419,14 +508,38 @@ class QueryPlanner:
                 key = e
                 if key not in agg_refs:
                     agg_refs[key] = plan_agg(e)
-                idxs = agg_refs[key]
-                if len(idxs) == 1:
-                    return _PostAggColumn(n_key + idxs[0])
-                # avg = sum / count
-                return ast.BinaryOp(
-                    "/",
-                    _PostAggColumn(n_key + idxs[0]),
-                    _PostAggColumn(n_key + idxs[1]),
+                kind, idxs = agg_refs[key]
+                cols_ = [_PostAggColumn(n_key + i) for i in idxs]
+                if kind == "plain":
+                    return cols_[0]
+                if kind == "avg":
+                    return ast.BinaryOp("/", cols_[0], cols_[1])
+                # variance family: E[x^2] and E[x]^2 from (sum, sum_sq,
+                # count); sample variants divide by (count - 1), whose
+                # zero denominator yields NULL (matching pg's NULL for
+                # n<2); numeric noise is clamped at 0 before sqrt
+                s, ss, c = cols_
+                num = ast.BinaryOp(
+                    "-",
+                    ss,
+                    ast.BinaryOp("/", ast.BinaryOp("*", s, s), c),
+                )
+                num = ast.FuncCall("greatest", (num, ast.NumberLit("0.0")))
+                denom = (
+                    ast.BinaryOp("-", c, ast.NumberLit("1"))
+                    if kind in ("stddev", "stddev_samp", "var_samp",
+                                "variance")
+                    else c
+                )
+                var = ast.BinaryOp("/", num, denom)
+                if kind in ("stddev", "stddev_samp", "stddev_pop"):
+                    var = ast.FuncCall("sqrt", (var,))
+                # all-NULL groups: sum is NULL and must stay NULL (the
+                # greatest() clamp above would otherwise turn it into 0)
+                return ast.Case(
+                    None,
+                    ((ast.IsNull(s, negated=True), var),),
+                    ast.NullLit(),
                 )
             if isinstance(e, ast.BinaryOp):
                 return ast.BinaryOp(e.op, rewrite(e.left), rewrite(e.right))
@@ -552,7 +665,27 @@ class QueryPlanner:
             return HLiteral(e.value, ColumnType.BOOL)
         if isinstance(e, ast.NullLit):
             return HLiteral(None, ColumnType.INT64)
+        if isinstance(e, ast.IntervalLit):
+            raise PlanError(
+                "interval literals are only supported in +/- expressions"
+            )
         if isinstance(e, ast.BinaryOp):
+            if e.op in ("+", "-") and isinstance(e.right, ast.IntervalLit):
+                iv = e.right
+                sgn = 1 if e.op == "+" else -1
+                return HCallVariadic(
+                    VariadicFunc.ADD_INTERVAL,
+                    (
+                        self.plan_expr(e.left, scope),
+                        HLiteral(sgn * iv.months, ColumnType.INT64),
+                        HLiteral(sgn * iv.days, ColumnType.INT64),
+                        HLiteral(sgn * iv.ms, ColumnType.INT64),
+                    ),
+                )
+            if e.op == "+" and isinstance(e.left, ast.IntervalLit):
+                return self.plan_expr(
+                    ast.BinaryOp("+", e.right, e.left), scope
+                )
             if e.op == "and":
                 return HCallVariadic(
                     VariadicFunc.AND,
@@ -579,6 +712,16 @@ class QueryPlanner:
         if isinstance(e, ast.UnaryOp):
             inner = self.plan_expr(e.expr, scope)
             if e.op == "-":
+                if (
+                    isinstance(inner, HLiteral)
+                    and inner.value is not None
+                    and inner.ctype is not ColumnType.STRING
+                ):
+                    # fold -literal so literal-argument positions
+                    # (round(x, -1), LIMIT arithmetic) see a Literal
+                    return HLiteral(
+                        -inner.value, inner.ctype, inner.scale
+                    )
                 return HCallUnary(UnaryFunc.NEG, inner)
             if e.op == "not":
                 return HCallUnary(UnaryFunc.NOT, inner)
@@ -638,46 +781,19 @@ class QueryPlanner:
                 out = HIf(cond, res, out)
             return out
         if isinstance(e, ast.Cast):
-            inner = self.plan_expr(e.expr, scope)
-            ty = type_from_name(e.to_type)
-            if ty is ColumnType.INT64:
-                return HCallUnary(UnaryFunc.CAST_INT64, inner)
-            if ty is ColumnType.FLOAT64:
-                return HCallUnary(UnaryFunc.CAST_FLOAT64, inner)
-            raise PlanError(f"unsupported cast to {e.to_type}")
+            return self._plan_cast(e, scope)
         if isinstance(e, ast.Extract):
-            funcs = {
-                "year": UnaryFunc.EXTRACT_YEAR,
-                "month": UnaryFunc.EXTRACT_MONTH,
-                "day": UnaryFunc.EXTRACT_DAY,
-                "quarter": UnaryFunc.EXTRACT_QUARTER,
-            }
-            if e.part not in funcs:
+            if e.part not in UnaryFunc.EXTRACTS:
                 raise PlanError(f"EXTRACT({e.part}) unsupported")
             return HCallUnary(
-                funcs[e.part], self.plan_expr(e.expr, scope)
+                UnaryFunc.EXTRACTS[e.part], self.plan_expr(e.expr, scope)
             )
         if isinstance(e, ast.FuncCall):
             if e.name in _AGG_FUNCS or e.star:
                 raise PlanError(
                     f"aggregate {e.name} in a non-aggregated context"
                 )
-            if e.name == "coalesce":
-                return HCallVariadic(
-                    VariadicFunc.COALESCE,
-                    tuple(self.plan_expr(a, scope) for a in e.args),
-                )
-            if e.name == "abs":
-                return HCallUnary(
-                    UnaryFunc.ABS, self.plan_expr(e.args[0], scope)
-                )
-            if e.name == "mz_now":
-                if e.args:
-                    raise PlanError("mz_now() takes no arguments")
-                from .hir import HMzNow
-
-                return HMzNow()
-            raise PlanError(f"unknown function {e.name}")
+            return self._plan_func(e, scope)
         if isinstance(e, ast.Exists):
             rel, _ = self.plan_query(e.query)
             return HExists(rel)
@@ -689,6 +805,112 @@ class QueryPlanner:
             x = self.plan_expr(e.expr, scope)
             return HInSubquery(x, rel, e.negated)
         raise NotImplementedError(type(e).__name__)
+
+    def _plan_cast(self, e: ast.Cast, scope: Scope):
+        """CAST(expr AS type) — the typeconv analog (sql/src/plan/typeconv.rs).
+
+        String literals cast to DATE/TIMESTAMP are parsed at plan time;
+        decimal(p,s) casts carry the target scale as a literal operand."""
+        from .hir import parse_type
+
+        ty, cast_scale = parse_type(e.to_type)
+        inner_ast = e.expr
+        if ty in (ColumnType.DATE, ColumnType.TIMESTAMP) and isinstance(
+            inner_ast, ast.StringLit
+        ):
+            return HLiteral(_parse_datetime_literal(inner_ast.value, ty), ty)
+        inner = self.plan_expr(inner_ast, scope)
+        if ty is ColumnType.INT64:
+            return HCallUnary(UnaryFunc.CAST_INT64, inner)
+        if ty is ColumnType.INT32:
+            return HCallUnary(UnaryFunc.CAST_INT32, inner)
+        if ty is ColumnType.FLOAT64:
+            return HCallUnary(UnaryFunc.CAST_FLOAT64, inner)
+        if ty is ColumnType.BOOL:
+            return HCallUnary(UnaryFunc.CAST_BOOL, inner)
+        if ty is ColumnType.DATE:
+            return HCallUnary(UnaryFunc.CAST_DATE, inner)
+        if ty is ColumnType.TIMESTAMP:
+            return HCallUnary(UnaryFunc.CAST_TIMESTAMP, inner)
+        if ty is ColumnType.DECIMAL:
+            return HCallBinary(
+                BinaryFunc.CAST_DECIMAL,
+                inner,
+                HLiteral(cast_scale, ColumnType.INT64),
+            )
+        if ty is ColumnType.STRING and isinstance(inner, HLiteral):
+            if inner.ctype is ColumnType.STRING:
+                return inner
+        raise PlanError(f"unsupported cast to {e.to_type}")
+
+    def _plan_func(self, e: ast.FuncCall, scope: Scope):
+        """Scalar function dispatch (the func.rs library analog)."""
+        name = e.name
+
+        def arg(i: int):
+            return self.plan_expr(e.args[i], scope)
+
+        def allargs():
+            return tuple(self.plan_expr(a, scope) for a in e.args)
+
+        if name == "coalesce":
+            return HCallVariadic(VariadicFunc.COALESCE, allargs())
+        if name in ("greatest", "least"):
+            return HCallVariadic(
+                VariadicFunc.GREATEST
+                if name == "greatest"
+                else VariadicFunc.LEAST,
+                allargs(),
+            )
+        if name == "nullif":
+            a, b = arg(0), arg(1)
+            # NULL only when a = b is TRUE (an unknown comparison —
+            # either side NULL — returns a, per pg); the untyped NULL
+            # branch defers typing to a (If._principal)
+            return HIf(
+                HCallBinary(BinaryFunc.EQ, a, b),
+                HLiteral(None, ColumnType.INT64),
+                a,
+            )
+        if name in _UNARY_FUNC_NAMES:
+            if len(e.args) != 1:
+                raise PlanError(f"{name} takes one argument")
+            return HCallUnary(_UNARY_FUNC_NAMES[name], arg(0))
+        if name == "round":
+            if len(e.args) == 1:
+                return HCallUnary(UnaryFunc.ROUND, arg(0))
+            return HCallBinary(BinaryFunc.ROUND_TO, arg(0), arg(1))
+        if name == "log":
+            if len(e.args) == 1:
+                return HCallUnary(UnaryFunc.LOG10, arg(0))
+            return HCallBinary(BinaryFunc.LOG_BASE, arg(0), arg(1))
+        if name in ("power", "pow"):
+            return HCallBinary(BinaryFunc.POWER, arg(0), arg(1))
+        if name == "mod":
+            return HCallBinary(BinaryFunc.MOD, arg(0), arg(1))
+        if name == "pi":
+            import math
+
+            return HLiteral(math.pi, ColumnType.FLOAT64)
+        if name in ("date_trunc", "date_part"):
+            part_ast = e.args[0]
+            if not isinstance(part_ast, ast.StringLit):
+                raise PlanError(f"{name}: part must be a string literal")
+            part = part_ast.value.lower()
+            if name == "date_trunc":
+                table = UnaryFunc.DATE_TRUNCS
+            else:
+                table = UnaryFunc.EXTRACTS
+            if part not in table:
+                raise PlanError(f"{name}({part!r}) unsupported")
+            return HCallUnary(table[part], arg(1))
+        if name == "mz_now":
+            if e.args:
+                raise PlanError("mz_now() takes no arguments")
+            from .hir import HMzNow
+
+            return HMzNow()
+        raise PlanError(f"unknown function {name}")
 
 
 from dataclasses import dataclass

@@ -280,6 +280,17 @@ class MaintainedView:
         frontier-joined progress. Returns False if the inputs did not
         advance within the timeout."""
         lower = self.upper
+        if not self.sources:
+            # A source-less (pure constant) dataflow: one step at time 0
+            # emits the constants, then the frontier is complete.
+            if lower > 0:
+                return False
+            self.df.time = 0
+            out = self.df.step({})
+            out = self.df.gather_delta(out)
+            self._append(out, 0, 1, 0)
+            self._upper = 1
+            return True
         target = None
         for s in self.sources.values():
             upper = s.reader.wait_for_upper(lower, timeout)  # > lower

@@ -97,11 +97,13 @@ def parse_slt(text: str) -> list[Record]:
 
 
 def _fmt(v) -> str:
+    import decimal
+
     if v is None:
         return "NULL"
     if isinstance(v, bool):
         return "true" if v else "false"
-    if isinstance(v, float):
+    if isinstance(v, (float, decimal.Decimal)):
         # SLT convention: 3 decimal places for reals.
         return f"{v:.3f}"
     return str(v)

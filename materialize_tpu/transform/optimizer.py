@@ -20,6 +20,8 @@ from dataclasses import replace
 
 from ..expr import relation as mir
 from ..expr import scalar as ms
+from ..expr.relation import AggregateExpr, AggregateFunc
+from ..repr.schema import ColumnType
 
 
 def _children_replaced(expr: mir.RelationExpr, f):
@@ -288,7 +290,160 @@ def join_implementation(expr: mir.RelationExpr) -> mir.RelationExpr:
     return _bottom_up(expr, rw)
 
 
+def plan_distinct_aggregates(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """Rewrite Reduce nodes containing DISTINCT aggregates into a join of
+    plain reduces (the reference plans distinct aggs via per-aggregate
+    Distinct stages, compute-types/src/plan/reduce.rs; here the rewrite
+    happens MIR->MIR so the render layer never sees a distinct flag):
+
+        Reduce(R, K, [..nd.., agg_d(e) DISTINCT])
+     => Project(Join(
+            Reduce(R', K', nd),                       -- nd reduce
+            Reduce(Distinct(Project(R', K'+[e])), K', [agg_d(e)]),
+            on K'), restore-order)
+
+    K' is a null-safe key encoding: a nullable key column k becomes
+    (coalesce(k, 0), is_null(k)) so NULL-key groups survive the join
+    (the device equijoin drops NULL keys, ops/join.py null_key_diffs);
+    the original key value is re-derived afterwards. NULL values of e
+    stay in the distinct set; downstream aggregates skip NULLs (the
+    accumulator masks, ops/reduce.py delta_contributions)."""
+
+    def rw(e):
+        if not isinstance(e, mir.Reduce):
+            return e
+        if not any(a.distinct for a in e.aggregates):
+            return e
+        aggs = [
+            AggregateExpr(a.func, a.expr, False)
+            if a.distinct
+            and a.func in (AggregateFunc.MIN, AggregateFunc.MAX)
+            else a
+            for a in e.aggregates
+        ]
+        if not any(a.distinct for a in aggs):
+            return mir.Reduce(e.input, e.group_key, tuple(aggs))
+
+        inp = e.input
+        in_schema = inp.schema()
+        arity = in_schema.arity
+
+        # 1. null-safe key encoding appended via one Map
+        scalars = []
+        key_exprs: list[tuple] = []  # per original key: encoded col idxs
+        decode: list = []  # scalar exprs over the joined K' to recover keys
+        kp = 0  # position within K'
+        for ki in e.group_key:
+            c = in_schema[ki]
+            if c.nullable:
+                zero = ms.Literal(
+                    False if c.ctype is ColumnType.BOOL else 0,
+                    c.ctype,
+                    c.scale,
+                )
+                scalars.append(
+                    ms.CallVariadic(
+                        ms.VariadicFunc.COALESCE, (ms.ColumnRef(ki), zero)
+                    )
+                )
+                scalars.append(
+                    ms.CallUnary(ms.UnaryFunc.IS_NULL, ms.ColumnRef(ki))
+                )
+                v_idx = arity + len(scalars) - 2
+                n_idx = arity + len(scalars) - 1
+                key_exprs.append((v_idx, n_idx))
+                decode.append(
+                    ms.If(
+                        ms.ColumnRef(kp + 1),
+                        ms.Literal(None, c.ctype, c.scale),
+                        ms.ColumnRef(kp),
+                    )
+                )
+                kp += 2
+            else:
+                key_exprs.append((ki,))
+                decode.append(ms.ColumnRef(kp))
+                kp += 1
+        enc = mir.Map(inp, tuple(scalars)) if scalars else inp
+        kprime = tuple(i for ks in key_exprs for i in ks)
+        nk = len(kprime)
+
+        # 2. partition aggregates (tracking original positions)
+        nd = [(p, a) for p, a in enumerate(aggs) if not a.distinct]
+        d_groups: list[tuple] = []  # (expr, [(pos, agg)...]) structural
+        for p, a in enumerate(aggs):
+            if not a.distinct:
+                continue
+            for ge, lst in d_groups:
+                if ge == a.expr:
+                    lst.append((p, a))
+                    break
+            else:
+                d_groups.append((a.expr, [(p, a)]))
+
+        parts = []  # (relation, [original agg positions])
+        if nd:
+            parts.append(
+                (
+                    mir.Reduce(enc, kprime, tuple(a for _, a in nd)),
+                    [p for p, _ in nd],
+                )
+            )
+        enc_arity = enc.schema().arity
+        for ge, lst in d_groups:
+            with_e = mir.Map(enc, (ge,))
+            dedup = mir.Reduce(
+                mir.Project(with_e, kprime + (enc_arity,)),
+                tuple(range(nk + 1)),
+                (),
+            )
+            red = mir.Reduce(
+                dedup,
+                tuple(range(nk)),
+                tuple(
+                    AggregateExpr(a.func, ms.ColumnRef(nk), False)
+                    for _, a in lst
+                ),
+            )
+            parts.append((red, [p for p, _ in lst]))
+
+        if len(parts) == 1:
+            joined, layout = parts[0]
+            base = nk
+            positions = {p: base + i for i, p in enumerate(layout)}
+        else:
+            # equi-join all parts on K' (each part's first nk columns)
+            offs, cols_so_far, inputs = [], 0, []
+            for rel, _ in parts:
+                offs.append(cols_so_far)
+                cols_so_far += rel.schema().arity
+                inputs.append(rel)
+            equivs = tuple(
+                tuple(
+                    ms.ColumnRef(off + j) for off in offs
+                )
+                for j in range(nk)
+            )
+            joined = mir.Join(tuple(inputs), equivs)
+            positions = {}
+            for (rel, layout), off in zip(parts, offs):
+                for i, p in enumerate(layout):
+                    positions[p] = off + nk + i
+        # 3. restore output order: decoded keys, then aggregates
+        out_scalars = tuple(decode) + tuple(
+            ms.ColumnRef(positions[p]) for p in range(len(aggs))
+        )
+        jarity = joined.schema().arity
+        return mir.Project(
+            mir.Map(joined, out_scalars),
+            tuple(range(jarity, jarity + len(out_scalars))),
+        )
+
+    return _bottom_up(expr, rw)
+
+
 LOGICAL_TRANSFORMS = (
+    plan_distinct_aggregates,
     fuse,
     fold_constants,
     predicate_pushdown,

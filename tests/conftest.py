@@ -15,3 +15,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Lower the persistent-cache threshold for the suite: it is dominated by
+# many sub-second CPU compiles of per-capacity-tier dataflow steps that
+# are identical across runs (the cache itself is configured process-wide
+# in materialize_tpu/__init__.py).
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)

@@ -384,7 +384,12 @@ class TestCoordinator:
                 n + int(diff[i]),
                 s + int(diff[i]) * int(cols[qty][i]),
             )
+        import decimal
+
+        # l_quantity is DECIMAL(_, 2): results surface as exact decimals
         expect = sorted(
-            (k[0], k[1], s, n) for k, (n, s) in acc.items() if n
+            (k[0], k[1], decimal.Decimal(s) / 100, n)
+            for k, (n, s) in acc.items()
+            if n
         )
         assert sorted(res.rows) == expect
