@@ -28,6 +28,7 @@ from ..sql.catalog import Catalog as SqlCatalog
 from ..sql.catalog import CatalogItem
 from ..sql.hir import PlanError
 from ..sql.plan import (
+    CopyFromPlan,
     CreateIndexPlan,
     CreateSourcePlan,
     CreateTablePlan,
@@ -68,13 +69,15 @@ class ExecuteResult:
     """What a statement returns to the session (ExecuteResponse analog,
     adapter/src/command.rs)."""
 
-    kind: str  # "rows" | "text" | "ok" | "subscription"
+    kind: str  # "rows" | "text" | "ok" | "subscription" | "copy_in"
     rows: list = field(default_factory=list)
     columns: tuple = ()
     text: str = ""
     subscription: object = None
     schema: object = None  # result Schema (wire type OIDs)
     affected: int = 0  # DML row count (wire CommandComplete tag)
+    copy_out: bool = False  # stream rows via the COPY-out subprotocol
+    table: str = ""  # copy_in target
 
 
 class Coordinator:
@@ -207,6 +210,21 @@ class Coordinator:
             return self._sequence_create_webhook(plan, sql, replay, record)
         if isinstance(plan, InsertPlan):
             return self._sequence_insert(plan)
+        if isinstance(plan, CopyFromPlan):
+            it = self._check_writable_table(plan.table)
+            cols = plan.columns or tuple(
+                c.name for c in it.schema.columns
+            )
+            known = {c.name for c in it.schema.columns}
+            for c in cols:
+                if c not in known:
+                    raise PlanError(
+                        f"column {c!r} of {plan.table!r} does not exist"
+                    )
+            res = ExecuteResult("copy_in")
+            res.table = plan.table
+            res.columns = cols
+            return res
         if isinstance(plan, DeletePlan):
             return self._sequence_delete(plan)
         if isinstance(plan, UpdatePlan):
@@ -233,7 +251,9 @@ class Coordinator:
                 columns=(plan.name,),
             )
         if isinstance(plan, SelectPlan):
-            return self._sequence_peek(plan)
+            res = self._sequence_peek(plan)
+            res.copy_out = plan.copy_out
+            return res
         if isinstance(plan, SubscribePlan):
             return self._sequence_subscribe(plan)
         if isinstance(plan, DropPlan):
@@ -461,6 +481,44 @@ class Coordinator:
             plan.table, cols, nulls, np.ones(len(plan.rows), np.int64)
         )
         return ExecuteResult("ok", affected=len(plan.rows))
+
+    def copy_in_rows(
+        self, table: str, columns: tuple, text_rows: list
+    ) -> int:
+        """Finish a COPY table FROM STDIN: parse pg-text rows into
+        values for the named columns (others NULL) and group-commit
+        them (the reference's COPY-in lands in the same table-write
+        path as INSERT, protocol.rs COPY -> adapter appends)."""
+        it = self._check_writable_table(table)
+        by_name = {c.name: i for i, c in enumerate(it.schema.columns)}
+        positions = [by_name[c] for c in columns]
+        rows = []
+        for ln, parts in enumerate(text_rows):
+            if len(parts) != len(columns):
+                raise PlanError(
+                    f"COPY row {ln + 1} has {len(parts)} fields, "
+                    f"expected {len(columns)}"
+                )
+            row = [None] * it.schema.arity
+            for pos, raw in zip(positions, parts):
+                col = it.schema.columns[pos]
+                row[pos] = (
+                    None if raw is None else _parse_text_value(raw, col)
+                )
+            for v, col in zip(row, it.schema.columns):
+                if v is None and not col.nullable:
+                    raise PlanError(
+                        f"null value in non-nullable column {col.name!r}"
+                    )
+            rows.append(tuple(row))
+        if not rows:
+            return 0
+        with self._lock:
+            cols_arr, nulls = self._encode_insert(it.schema, rows)
+            self._group_commit(
+                table, cols_arr, nulls, np.ones(len(rows), np.int64)
+            )
+        return len(rows)
 
     def _group_commit(self, table: str, cols, nulls, diffs) -> int:
         """Group commit on the shared table timeline (coord/appends.rs
@@ -1057,6 +1115,48 @@ class Subscription:
         self.coord.controller.drop_dataflow(self.df_name)
         self.coord._df_upstream.pop(self.df_name, None)
         self.reader.expire()
+
+
+def _parse_text_value(raw: str, col: Column):
+    """pg COPY text-format field -> python value for the column type."""
+    import datetime as _dt
+    import decimal as _dec
+
+    t = col.ctype
+    try:
+        if t is ColumnType.BOOL:
+            s = raw.strip().lower()
+            if s in ("t", "true", "1", "yes", "on"):
+                return True
+            if s in ("f", "false", "0", "no", "off"):
+                return False
+            raise ValueError(raw)
+        if t in (ColumnType.INT32, ColumnType.INT64):
+            return int(raw)
+        if t is ColumnType.FLOAT64:
+            return float(raw)
+        if t is ColumnType.DECIMAL:
+            return _dec.Decimal(raw)
+        if t is ColumnType.DATE:
+            s = raw.strip()
+            if s.lstrip("-").isdigit():
+                return int(s)  # days-since-epoch shorthand
+            return (
+                _dt.date.fromisoformat(s) - _dt.date(1970, 1, 1)
+            ).days
+        if t is ColumnType.TIMESTAMP:
+            s = raw.strip()
+            if s.lstrip("-").isdigit():
+                return int(s)  # ms-since-epoch shorthand
+            dt = _dt.datetime.fromisoformat(s.replace("T", " "))
+            return int(
+                (dt - _dt.datetime(1970, 1, 1)).total_seconds() * 1000
+            )
+        return raw
+    except (ValueError, _dec.InvalidOperation) as exc:
+        raise PlanError(
+            f"invalid {t.value} value {raw!r} for column {col.name!r}"
+        ) from exc
 
 
 def _coerce_internal(v, from_col: Column, to_col: Column):

@@ -111,6 +111,13 @@ def make_handler(coordinator):
                         results.append(
                             {"tag": "EXPLAIN", "text": res.text}
                         )
+                    elif res.kind == "copy_in":
+                        results.append(
+                            {
+                                "error": "COPY FROM STDIN is not "
+                                "supported over HTTP; use pgwire"
+                            }
+                        )
                     elif res.kind == "subscription":
                         res.subscription.close()
                         results.append(

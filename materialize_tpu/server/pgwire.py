@@ -45,11 +45,113 @@ def _cstr(s: str) -> bytes:
     return s.encode() + b"\x00"
 
 
+class _Prepared:
+    """A named prepared statement (extended protocol Parse target)."""
+
+    def __init__(self, sql: str, param_oids: tuple):
+        self.sql = sql
+        self.param_oids = param_oids
+        self.nparams = _max_param(sql)
+
+
+class _Portal:
+    """A bound portal: statement + parameter values, partially
+    executable with row limits (protocol.rs portal machinery)."""
+
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.result = None  # ExecuteResult once executed
+        self.sent = 0  # rows already sent (Execute with maxrows)
+
+
+def _max_param(sql: str) -> int:
+    """Highest $N placeholder outside string literals."""
+    import re
+
+    n = 0
+    in_str = False
+    i = 0
+    while i < len(sql):
+        ch = sql[i]
+        if ch == "'":
+            in_str = not in_str
+        elif not in_str and ch == "$":
+            m = re.match(r"\$(\d+)", sql[i:])
+            if m:
+                n = max(n, int(m.group(1)))
+                i += len(m.group(0))
+                continue
+        i += 1
+    return n
+
+
+# OID families for parameter typing (Parse's declared param_oids)
+_NUMERIC_OIDS = {20, 21, 23, 26, 700, 701, 1700}
+_TEXT_OIDS = {25, 1042, 1043, 18, 19}
+_BOOL_OID = 16
+
+
+def _substitute_params(
+    sql: str, values: list, param_oids: tuple = ()
+) -> str:
+    """Inline bound parameter values as SQL literals ($N -> literal).
+    The reference carries typed Datums through portals; the text
+    protocol's values are re-parsed here. A parameter whose Parse
+    message declared an OID is typed by it; undeclared (OID 0/absent)
+    parameters fall back to a numeric-looking heuristic — ambiguous for
+    text columns holding digit strings, in which case clients should
+    declare OIDs (drivers that prepare with types do)."""
+    import re
+
+    def lit(idx, v):
+        if v is None:
+            return "NULL"
+        s = v if isinstance(v, str) else v.decode()
+        oid = param_oids[idx] if idx < len(param_oids) else 0
+        if oid in _TEXT_OIDS:
+            return "'" + s.replace("'", "''") + "'"
+        if oid in _NUMERIC_OIDS:
+            return s
+        if oid == _BOOL_OID:
+            return "true" if s.strip().lower() in (
+                "t", "true", "1", "yes", "on"
+            ) else "false"
+        if re.fullmatch(r"-?\d+(\.\d+)?([eE][+-]?\d+)?", s):
+            return s
+        if s.lower() in ("true", "false"):
+            return s
+        return "'" + s.replace("'", "''") + "'"
+
+    out, i, in_str = [], 0, False
+    while i < len(sql):
+        ch = sql[i]
+        if ch == "'":
+            in_str = not in_str
+            out.append(ch)
+            i += 1
+            continue
+        if not in_str and ch == "$":
+            m = re.match(r"\$(\d+)", sql[i:])
+            if m:
+                idx = int(m.group(1)) - 1
+                if idx >= len(values):
+                    raise ValueError(f"parameter ${idx + 1} not bound")
+                out.append(lit(idx, values[idx]))
+                i += len(m.group(0))
+                continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
 class PgConnection:
     def __init__(self, sock: socket.socket, coordinator):
         self.sock = sock
         self.coord = coordinator
         self.alive = True
+        self.prepared: dict[str, _Prepared] = {}
+        self.portals: dict[str, _Portal] = {}
+        self._skip_until_sync = False
 
     # -- low-level ----------------------------------------------------------
     def _recv_exact(self, n: int) -> bytes:
@@ -80,16 +182,23 @@ class PgConnection:
                     self._handle_query(payload[:-1].decode())
                 elif tag == b"X":
                     return
-                elif tag in (b"P", b"B", b"D", b"E", b"S", b"C"):
-                    # Extended protocol: not implemented; report cleanly
-                    # once a Sync arrives.
-                    if tag == b"S":
-                        self._error(
-                            "0A000",
-                            "extended query protocol not supported; "
-                            "use simple queries",
-                        )
-                        self._ready()
+                elif tag == b"S":  # Sync: end of extended batch
+                    self._skip_until_sync = False
+                    self._ready()
+                elif self._skip_until_sync:
+                    continue  # drop messages until Sync after an error
+                elif tag == b"P":
+                    self._handle_parse(payload)
+                elif tag == b"B":
+                    self._handle_bind(payload)
+                elif tag == b"D":
+                    self._handle_describe(payload)
+                elif tag == b"E":
+                    self._handle_execute(payload)
+                elif tag == b"C":
+                    self._handle_close(payload)
+                elif tag == b"H":  # Flush: all responses sent eagerly
+                    pass
                 else:
                     self._error("08P01", f"unknown message {tag!r}")
                     self._ready()
@@ -154,15 +263,205 @@ class PgConnection:
                     self._send_result(stmt, res)
                 except BrokenPipeError:
                     raise
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as e:
+                    # e.g. COPY parse failures / CopyFail: report and
+                    # keep the session alive
+                    self._error("XX000", str(e))
+                    self._ready()
+                    return
         self._ready()
 
+    # -- extended protocol (protocol.rs extended-query state machine) ------
+    def _ext_error(self, code: str, message: str) -> None:
+        """Error inside an extended-protocol batch: report and discard
+        messages until the Sync."""
+        self._error(code, message)
+        self._skip_until_sync = True
+
+    def _handle_parse(self, payload: bytes) -> None:
+        name, off = _read_cstr(payload, 0)
+        sql, off = _read_cstr(payload, off)
+        (noids,) = struct.unpack_from("!h", payload, off)
+        off += 2
+        oids = struct.unpack_from(f"!{noids}I", payload, off)
+        try:
+            stmts = [s for s in _split_statements(sql) if s.strip()]
+            if len(stmts) > 1:
+                raise ValueError(
+                    "cannot prepare multiple statements at once"
+                )
+            self.prepared[name] = _Prepared(
+                stmts[0] if stmts else "", tuple(oids)
+            )
+            self._send(_msg(b"1", b""))  # ParseComplete
+        except Exception as e:
+            self._ext_error("42601", str(e))
+
+    def _handle_bind(self, payload: bytes) -> None:
+        try:
+            portal, off = _read_cstr(payload, 0)
+            stmt_name, off = _read_cstr(payload, off)
+            (nfmt,) = struct.unpack_from("!h", payload, off)
+            off += 2
+            fmts = struct.unpack_from(f"!{nfmt}h", payload, off)
+            off += 2 * nfmt
+            (nparams,) = struct.unpack_from("!h", payload, off)
+            off += 2
+            values = []
+            for i in range(nparams):
+                (ln,) = struct.unpack_from("!i", payload, off)
+                off += 4
+                if ln == -1:
+                    values.append(None)
+                else:
+                    raw = payload[off : off + ln]
+                    off += ln
+                    fmt = fmts[i] if i < len(fmts) else (
+                        fmts[0] if len(fmts) == 1 else 0
+                    )
+                    if fmt != 0:
+                        raise ValueError(
+                            "binary parameter format not supported"
+                        )
+                    values.append(raw.decode())
+            # result formats: text (0) only
+            (nrfmt,) = struct.unpack_from("!h", payload, off)
+            off += 2
+            rfmts = struct.unpack_from(f"!{nrfmt}h", payload, off)
+            if any(f != 0 for f in rfmts):
+                raise ValueError("binary result format not supported")
+            ps = self.prepared.get(stmt_name)
+            if ps is None:
+                raise ValueError(
+                    f"prepared statement {stmt_name!r} does not exist"
+                )
+            self.portals[portal] = _Portal(
+                _substitute_params(ps.sql, values, ps.param_oids)
+            )
+            self._send(_msg(b"2", b""))  # BindComplete
+        except Exception as e:
+            self._ext_error("08P01", str(e))
+
+    def _describe_results(self, sql: str) -> None:
+        """RowDescription (or NoData) for a statement/portal by planning
+        it without executing (Describe; the reference's describe path
+        runs the planner's describe-only mode, sql/src/plan/statement.rs)."""
+        from ..sql import parser as sqlparser
+        from ..sql.plan import SelectPlan, plan_statement
+
+        try:
+            stmt = sqlparser.parse_statement(sql)
+            plan = plan_statement(stmt, self.coord.catalog)
+        except Exception:
+            self._send(_msg(b"n", b""))  # NoData for unplannable here
+            return
+        if isinstance(plan, SelectPlan):
+            self._row_description(plan.column_names, plan.expr.schema())
+        else:
+            self._send(_msg(b"n", b""))
+
+    def _handle_describe(self, payload: bytes) -> None:
+        kind = payload[0:1]
+        name, _ = _read_cstr(payload, 1)
+        if kind == b"S":
+            ps = self.prepared.get(name)
+            if ps is None:
+                self._ext_error(
+                    "26000", f"prepared statement {name!r} does not exist"
+                )
+                return
+            # ParameterDescription: unknown params described as text
+            oids = list(ps.param_oids) + [25] * (
+                ps.nparams - len(ps.param_oids)
+            )
+            self._send(
+                _msg(
+                    b"t",
+                    struct.pack("!h", len(oids))
+                    + b"".join(struct.pack("!I", o) for o in oids),
+                )
+            )
+            self._describe_results(
+                _substitute_params(ps.sql, [None] * ps.nparams)
+                if ps.nparams
+                else ps.sql
+            )
+        elif kind == b"P":
+            po = self.portals.get(name)
+            if po is None:
+                self._ext_error(
+                    "34000", f"portal {name!r} does not exist"
+                )
+                return
+            self._describe_results(po.sql)
+        else:
+            self._ext_error("08P01", f"bad describe kind {kind!r}")
+
+    def _handle_execute(self, payload: bytes) -> None:
+        name, off = _read_cstr(payload, 0)
+        (maxrows,) = struct.unpack_from("!i", payload, off)
+        po = self.portals.get(name)
+        if po is None:
+            self._ext_error("34000", f"portal {name!r} does not exist")
+            return
+        try:
+            if po.result is None:
+                if not po.sql.strip():
+                    self._send(_msg(b"I", b""))  # EmptyQueryResponse
+                    return
+                po.result = self.coord.execute(po.sql)
+                po.sent = 0
+            res = po.result
+            if res.kind == "rows" and getattr(res, "copy_out", False):
+                self._copy_out_rows(res)
+            elif res.kind == "copy_in":
+                self._copy_in(res)
+            elif res.kind == "rows":
+                schema = self._result_schema(res)
+                rows = res.rows[po.sent :]
+                if maxrows and maxrows > 0 and len(rows) > maxrows:
+                    for row in rows[:maxrows]:
+                        self._data_row(row, schema)
+                    po.sent += maxrows
+                    self._send(_msg(b"s", b""))  # PortalSuspended
+                    return
+                for row in rows:
+                    self._data_row(row, schema)
+                po.sent = len(res.rows)
+                self._complete(f"SELECT {len(res.rows)}")
+            elif res.kind == "subscription":
+                res.subscription.close()
+                self._ext_error(
+                    "0A000",
+                    "SUBSCRIBE requires the simple query protocol",
+                )
+            else:
+                self._send_result(po.sql, res)
+        except Exception as e:
+            self._ext_error("XX000", str(e))
+
+    def _handle_close(self, payload: bytes) -> None:
+        kind = payload[0:1]
+        name, _ = _read_cstr(payload, 1)
+        if kind == b"S":
+            self.prepared.pop(name, None)
+        else:
+            self.portals.pop(name, None)
+        self._send(_msg(b"3", b""))  # CloseComplete
+
     def _send_result(self, stmt: str, res) -> None:
-        if res.kind == "rows":
+        if res.kind == "rows" and getattr(res, "copy_out", False):
+            self._copy_out_rows(res)
+        elif res.kind == "rows":
             schema = self._result_schema(res)
             self._row_description(res.columns, schema)
             for row in res.rows:
                 self._data_row(row, schema)
             self._complete(f"SELECT {len(res.rows)}")
+        elif res.kind == "copy_in":
+            self._copy_in(res)
         elif res.kind == "text":
             self._row_description(res.columns or ("explain",), None)
             for line in res.text.split("\n"):
@@ -210,6 +509,67 @@ class PgConnection:
     def _complete(self, tag: str) -> None:
         self._send(_msg(b"C", _cstr(tag)))
 
+    def _copy_out_rows(self, res) -> None:
+        """COPY (query) TO STDOUT: rows in pg text format."""
+        n = len(res.columns)
+        self._send(
+            _msg(b"H", struct.pack("!bh", 0, n) + b"\x00\x00" * n)
+        )
+        lines = []
+        for row in res.rows:
+            lines.append(
+                "\t".join(_copy_text_field(v) for v in row) + "\n"
+            )
+        if lines:
+            self._send(_msg(b"d", "".join(lines).encode()))
+        self._send(_msg(b"c", b""))  # CopyDone
+        self._complete(f"COPY {len(res.rows)}")
+
+    def _copy_in(self, res) -> None:
+        """COPY table FROM STDIN: CopyInResponse, then CopyData until
+        CopyDone/CopyFail (text format)."""
+        n = len(res.columns)
+        self._send(
+            _msg(b"G", struct.pack("!bh", 0, n) + b"\x00\x00" * n)
+        )
+        chunks: list = []
+        while True:
+            tag = self._recv_exact(1)
+            (length,) = struct.unpack("!I", self._recv_exact(4))
+            payload = self._recv_exact(length - 4)
+            if tag == b"d":
+                chunks.append(payload)
+            elif tag == b"c":  # CopyDone
+                break
+            elif tag == b"f":  # CopyFail
+                raise ValueError(
+                    "COPY aborted by client: "
+                    + payload.rstrip(b"\x00").decode()
+                )
+            elif tag in (b"H", b"S"):  # Flush/Sync are legal no-ops here
+                continue
+            else:
+                raise ValueError(
+                    f"unexpected message {tag!r} during COPY"
+                )
+        rows = []
+        text = b"".join(chunks).decode()
+        lines = text.split("\n")
+        if lines and lines[-1] == "":
+            lines.pop()  # artifact of the terminating newline ONLY —
+            # interior empty lines are real single-column empty strings
+        for line in lines:
+            if line == "\\.":
+                continue
+            rows.append(
+                [
+                    None if f == "\\N" else _copy_unescape(f)
+                    for f in line.split("\t")
+                ]
+            )
+        count = self.coord.copy_in_rows(res.table, res.columns, rows)
+        self._complete(f"COPY {count}")
+
     def _stream_subscription(self, res) -> None:
         """SUBSCRIBE over the COPY-out subprotocol: one text line per
         update '(time, diff, cols...)', until the client disconnects
@@ -248,6 +608,51 @@ class PgConnection:
             pass
         finally:
             sub.close()
+
+
+_COPY_ESCAPES = {
+    "\\": "\\",
+    "t": "\t",
+    "n": "\n",
+    "r": "\r",
+    "b": "\b",
+    "f": "\f",
+    "v": "\v",
+}
+
+
+def _copy_unescape(field: str) -> str:
+    if "\\" not in field:
+        return field
+    out, i = [], 0
+    while i < len(field):
+        ch = field[i]
+        if ch == "\\" and i + 1 < len(field):
+            out.append(_COPY_ESCAPES.get(field[i + 1], field[i + 1]))
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def _copy_text_field(v) -> str:
+    if v is None:
+        return "\\N"
+    if isinstance(v, bool):
+        return "t" if v else "f"
+    s = str(v)
+    return (
+        s.replace("\\", "\\\\")
+        .replace("\t", "\\t")
+        .replace("\n", "\\n")
+        .replace("\r", "\\r")
+    )
+
+
+def _read_cstr(buf: bytes, off: int) -> tuple:
+    end = buf.index(b"\x00", off)
+    return buf[off:end].decode(), end + 1
 
 
 def _split_statements(sql: str) -> list[str]:

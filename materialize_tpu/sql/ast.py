@@ -337,5 +337,20 @@ class Subscribe(Statement):
 
 
 @dataclass(frozen=True)
+class CopyFrom(Statement):
+    """COPY table [(cols)] FROM STDIN (text format)."""
+
+    table: str
+    columns: tuple = ()
+
+
+@dataclass(frozen=True)
+class CopyTo(Statement):
+    """COPY (query) TO STDOUT / COPY table TO STDOUT."""
+
+    query: Query
+
+
+@dataclass(frozen=True)
 class ShowObjects(Statement):
     kind: str  # sources/views/indexes

@@ -37,6 +37,7 @@ KEYWORDS = {
     "default", "return", "at", "recursion", "tpch", "auction", "counter",
     "scale", "factor", "up", "to", "tick", "in", "columns",
     "delete", "update", "set",
+    "copy", "stdin", "stdout",
 }
 
 SYMBOLS = (

@@ -145,6 +145,41 @@ class Parser:
             else:
                 value = self.expect_ident()
             return ast.SetVar(name, value)
+        if self.accept_kw("copy"):
+            if self.accept_sym("("):
+                q = self.parse_query()
+                self.expect_sym(")")
+                self.expect_kw("to")
+                self.expect_kw("stdout")
+                return ast.CopyTo(q)
+            table = self.expect_ident()
+            cols: list = []
+            if self.accept_sym("("):
+                cols.append(self.expect_ident())
+                while self.accept_sym(","):
+                    cols.append(self.expect_ident())
+                self.expect_sym(")")
+            if self.accept_kw("to"):
+                self.expect_kw("stdout")
+                sel = ", ".join(cols) if cols else "*"
+                return ast.CopyTo(
+                    Parser(f"SELECT {sel} FROM {table}").parse_query()
+                )
+            self.expect_kw("from")
+            self.expect_kw("stdin")
+            # optional WITH (FORMAT TEXT) — text is the only format
+            if self.accept_kw("with"):
+                self.expect_sym("(")
+                depth = 1
+                while depth:
+                    t = self.next()
+                    if t.kind is TokKind.EOF:
+                        raise ParseError("unterminated COPY options")
+                    if t.text == "(":
+                        depth += 1
+                    elif t.text == ")":
+                        depth -= 1
+            return ast.CopyFrom(table, tuple(cols))
         if self.accept_kw("subscribe"):
             self.accept_kw("to")
             t = self.peek()

@@ -31,6 +31,9 @@ class SelectPlan(Plan):
     # RowSetFinishing ordering: (col_idx, desc, nulls_last) triples,
     # applied adapter-side to peek results (coord/peek.rs:910 analog).
     order_by: tuple = ()
+    # COPY (query) TO STDOUT: stream the result over the COPY-out
+    # subprotocol instead of DataRows
+    copy_out: bool = False
 
 
 @dataclass
@@ -78,6 +81,16 @@ class CreateWebhookPlan(Plan):
 class InsertPlan(Plan):
     table: str
     rows: list  # python value tuples, coerced to the table schema
+
+
+@dataclass
+class CopyFromPlan(Plan):
+    """COPY table FROM STDIN: the wire layer drives row collection and
+    hands text rows back to the coordinator (pgwire COPY-in;
+    reference protocol.rs COPY subprotocol)."""
+
+    table: str
+    columns: tuple  # optional column-name subset (empty = all)
 
 
 @dataclass
@@ -168,6 +181,17 @@ def _plan(stmt: ast.Statement, catalog: CatalogInterface) -> Plan:
         return CreateWebhookPlan(stmt.name, _table_schema(stmt.columns))
     if isinstance(stmt, ast.Insert):
         return _plan_insert(stmt, catalog)
+    if isinstance(stmt, ast.CopyFrom):
+        return CopyFromPlan(stmt.table, stmt.columns)
+    if isinstance(stmt, ast.CopyTo):
+        hir_rel, scope = qp.plan_query(stmt.query)
+        plan = SelectPlan(
+            lower(hir_rel),
+            tuple(it.name for it in scope.items),
+            getattr(qp, "finishing_order", ()),
+        )
+        plan.copy_out = True
+        return plan
     if isinstance(stmt, ast.Delete):
         hir_rel, _ = qp.plan_query(_table_query(stmt.table, stmt.where))
         return DeletePlan(stmt.table, lower(hir_rel))

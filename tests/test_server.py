@@ -83,6 +83,53 @@ class MiniPg:
                 tag_text = payload[:-1].decode()
         return columns, rows, error, tag_text
 
+    def _send_msg(self, tag: bytes, payload: bytes):
+        self.sock.sendall(tag + struct.pack("!I", len(payload) + 4) + payload)
+
+    def extended(self, sql: str, params: list, maxrows: int = 0,
+                 param_oids: tuple = ()):
+        """One Parse/Bind/Describe/Execute/Sync round trip. Returns
+        (msgs_by_tag, rows, error)."""
+        cstr = lambda s: s.encode() + b"\x00"
+        parse = cstr("") + cstr(sql) + struct.pack("!h", len(param_oids))
+        for o in param_oids:
+            parse += struct.pack("!I", o)
+        self._send_msg(b"P", parse)
+        bind = cstr("") + cstr("") + struct.pack("!h", 0)
+        bind += struct.pack("!h", len(params))
+        for p in params:
+            if p is None:
+                bind += struct.pack("!i", -1)
+            else:
+                b = str(p).encode()
+                bind += struct.pack("!i", len(b)) + b
+        bind += struct.pack("!h", 0)
+        self._send_msg(b"B", bind)
+        self._send_msg(b"D", b"P" + cstr(""))
+        self._send_msg(b"E", cstr("") + struct.pack("!i", maxrows))
+        self._send_msg(b"S", b"")
+        tags, rows, error = [], [], None
+        for tag, payload in self._read_until_ready():
+            tags.append(tag)
+            if tag == b"D":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", payload[off : off + 4])
+                    off += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(payload[off : off + ln].decode())
+                        off += ln
+                rows.append(tuple(row))
+            elif tag == b"E":
+                for f in payload.split(b"\x00"):
+                    if f[:1] == b"M":
+                        error = f[1:].decode()
+        return tags, rows, error
+
     def close(self):
         self.sock.sendall(b"X" + struct.pack("!I", 4))
         self.sock.close()
@@ -162,6 +209,148 @@ class TestPgwire:
             got += data
         assert b"1\t7" in got or b"\t1\t7" in got
         c.sock.close()  # drop mid-stream: server must clean up
+
+    def test_extended_protocol_prepared_statement(self, env):
+        c = MiniPg(env.pg.port)
+        c.query("CREATE TABLE ep (a bigint NOT NULL, b text NOT NULL)")
+        c.query("INSERT INTO ep VALUES (1,'x'), (2,'y'), (3,'x')")
+        # parameterized select through Parse/Bind/Describe/Execute
+        tags, rows, err = c.extended(
+            "SELECT a FROM ep WHERE b = $1 ORDER BY a", ["x"]
+        )
+        assert err is None, err
+        assert b"1" in tags and b"2" in tags  # Parse/BindComplete
+        assert b"T" in tags  # RowDescription from Describe
+        assert [r[0] for r in rows] == ["1", "3"]
+        # numeric parameter
+        _, rows, err = c.extended(
+            "SELECT b FROM ep WHERE a = $1", ["2"]
+        )
+        assert err is None and rows == [("y",)]
+        # a numeric-looking TEXT parameter with a declared text OID
+        c.query("INSERT INTO ep VALUES (9, '123')")
+        _, rows, err = c.extended(
+            "SELECT a FROM ep WHERE b = $1", ["123"], param_oids=(25,)
+        )
+        assert err is None and rows == [("9",)]
+        c.close()
+
+    def test_extended_protocol_maxrows_suspend(self, env):
+        c = MiniPg(env.pg.port)
+        c.query("CREATE TABLE ms (v bigint NOT NULL)")
+        c.query("INSERT INTO ms VALUES (1), (2), (3), (4)")
+        cstr = lambda s: s.encode() + b"\x00"
+        c._send_msg(b"P", cstr("") + cstr(
+            "SELECT v FROM ms ORDER BY v") + struct.pack("!h", 0))
+        c._send_msg(b"B", cstr("") + cstr("") + struct.pack("!hhh", 0, 0, 0))
+        c._send_msg(b"E", cstr("") + struct.pack("!i", 3))  # limit 3
+        c._send_msg(b"E", cstr("") + struct.pack("!i", 0))  # rest
+        c._send_msg(b"S", b"")
+        tags = [t for t, _ in c._read_until_ready()]
+        # 3 rows, PortalSuspended, remaining row, CommandComplete
+        assert tags.count(b"D") == 4
+        assert b"s" in tags and b"C" in tags
+        i_s, i_c = tags.index(b"s"), tags.index(b"C")
+        assert i_s < i_c
+        c.close()
+
+    def test_copy_in_and_out(self, env):
+        c = MiniPg(env.pg.port)
+        c.query(
+            "CREATE TABLE ct (a bigint NOT NULL, b text, d date NOT NULL)"
+        )
+        # COPY FROM STDIN (text format, \N nulls, ISO dates)
+        payload = b"COPY ct FROM STDIN\x00"
+        c.sock.sendall(
+            b"Q" + struct.pack("!I", len(payload) + 4) + payload
+        )
+        tag, data = c._read_msg()
+        assert tag == b"G", tag  # CopyInResponse
+        body = b"1\thello\t2024-01-15\n2\t\\N\t1970-01-01\n"
+        c._send_msg(b"d", body)
+        c._send_msg(b"c", b"")
+        tags = []
+        complete = None
+        while True:
+            tag, data = c._read_msg()
+            tags.append(tag)
+            if tag == b"C":
+                complete = data[:-1].decode()
+            if tag == b"Z":
+                break
+        assert complete == "COPY 2", (complete, tags)
+        cols, rows, err, _ = c.query(
+            "SELECT a, b, extract(year FROM d) FROM ct ORDER BY a"
+        )
+        assert err is None
+        assert rows == [("1", "hello", "2024"), ("2", None, "1970")]
+        # COPY (query) TO STDOUT round-trips the same text format
+        payload = b"COPY (SELECT a, b FROM ct) TO STDOUT\x00"
+        c.sock.sendall(
+            b"Q" + struct.pack("!I", len(payload) + 4) + payload
+        )
+        tag, data = c._read_msg()
+        assert tag == b"H", tag  # CopyOutResponse
+        out = b""
+        while True:
+            tag, data = c._read_msg()
+            if tag == b"d":
+                out += data
+            if tag == b"Z":
+                break
+        lines = sorted(out.decode().strip().split("\n"))
+        assert lines == ["1\thello", "2\t\\N"], lines
+        c.close()
+
+    def test_copy_in_empty_string_row_and_bad_bool(self, env):
+        c = MiniPg(env.pg.port)
+        c.query("CREATE TABLE ce (s text NOT NULL)")
+        payload = b"COPY ce FROM STDIN\x00"
+        c.sock.sendall(
+            b"Q" + struct.pack("!I", len(payload) + 4) + payload
+        )
+        tag, _ = c._read_msg()
+        assert tag == b"G"
+        c._send_msg(b"d", b"a\n\nb\n")  # middle row = empty string
+        c._send_msg(b"c", b"")
+        complete = None
+        while True:
+            tag, data = c._read_msg()
+            if tag == b"C":
+                complete = data[:-1].decode()
+            if tag == b"Z":
+                break
+        assert complete == "COPY 3", complete
+        # malformed boolean input is rejected, not coerced to false
+        c.query("CREATE TABLE cb (b bool NOT NULL)")
+        payload = b"COPY cb FROM STDIN\x00"
+        c.sock.sendall(
+            b"Q" + struct.pack("!I", len(payload) + 4) + payload
+        )
+        tag, _ = c._read_msg()
+        assert tag == b"G"
+        c._send_msg(b"d", b"flase\n")
+        c._send_msg(b"c", b"")
+        err = None
+        while True:
+            tag, data = c._read_msg()
+            if tag == b"E":
+                for f in data.split(b"\x00"):
+                    if f[:1] == b"M":
+                        err = f[1:].decode()
+            if tag == b"Z":
+                break
+        assert err is not None and "bool" in err
+        c.close()
+
+    def test_extended_protocol_error_skips_to_sync(self, env):
+        c = MiniPg(env.pg.port)
+        tags, rows, err = c.extended("SELECT nope FROM missing", [])
+        assert err is not None
+        # after Sync the session is usable again
+        cols, rows, err, _ = c.query("SELECT 1")
+        assert err is None and rows == [("1",)]
+        c.close()
 
 
 class TestHttp:
