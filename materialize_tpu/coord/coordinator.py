@@ -23,13 +23,14 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..expr import relation as mir
-from ..repr.schema import GLOBAL_DICT, Column, ColumnType, Schema
+from ..repr.schema import GLOBAL_DICT, parse_text_value, Column, ColumnType, Schema
 from ..sql.catalog import Catalog as SqlCatalog
 from ..sql.catalog import CatalogItem
 from ..sql.hir import PlanError
 from ..sql.plan import (
     CopyFromPlan,
     CreateIndexPlan,
+    CreateSinkPlan,
     CreateSourcePlan,
     CreateTablePlan,
     CreateViewPlan,
@@ -98,6 +99,7 @@ class Coordinator:
         self._table_writers: dict[str, WriteHandle] = {}
         self._webhooks: dict[str, WriteHandle] = {}
         self.sources: dict[str, GeneratorSource] = {}
+        self.sinks: dict[str, object] = {}  # KafkaSink by name
         self.subscriptions: dict[int, Subscription] = {}
         self._sub_seq = 0
         self.tick_interval = tick_interval
@@ -200,6 +202,8 @@ class Coordinator:
     ) -> ExecuteResult:
         if isinstance(plan, CreateSourcePlan):
             return self._sequence_create_source(plan, sql, replay, record)
+        if isinstance(plan, CreateSinkPlan):
+            return self._sequence_create_sink(plan, sql, replay, record)
         if isinstance(plan, CreateViewPlan):
             return self._sequence_create_view(plan, sql, replay, record)
         if isinstance(plan, CreateIndexPlan):
@@ -216,11 +220,17 @@ class Coordinator:
                 c.name for c in it.schema.columns
             )
             known = {c.name for c in it.schema.columns}
+            seen = set()
             for c in cols:
                 if c not in known:
                     raise PlanError(
                         f"column {c!r} of {plan.table!r} does not exist"
                     )
+                if c in seen:
+                    raise PlanError(
+                        f"column {c!r} specified more than once"
+                    )
+                seen.add(c)
             res = ExecuteResult("copy_in")
             res.table = plan.table
             res.columns = cols
@@ -292,6 +302,10 @@ class Coordinator:
     def _sequence_create_source(
         self, plan: CreateSourcePlan, sql, replay, record
     ) -> ExecuteResult:
+        options = dict(plan.options)
+        if plan.schema is not None:
+            options["_schema"] = plan.schema
+        options["_name"] = plan.name
         if not replay:
             # Validate EVERYTHING that can fail BEFORE the durable
             # record — a poison record would brick every future boot.
@@ -308,7 +322,7 @@ class Coordinator:
                 GENERATORS[plan.generator](
                     {
                         str(k).lower().replace(" ", "_"): v
-                        for k, v in plan.options.items()
+                        for k, v in options.items()
                     }
                 )
             except PlanError:
@@ -322,7 +336,7 @@ class Coordinator:
             self.persist,
             plan.name,
             plan.generator,
-            plan.options,
+            options,
             shard_prefix,
             tick_interval=self.tick_interval,
         )
@@ -340,16 +354,100 @@ class Coordinator:
                 ),
                 or_replace=True,
             )
+        if plan.name not in src.adapter.subsources:
+            # summary item for multi-subsource generators; an external
+            # source whose single subsource carries the source's own
+            # name (kafka) IS its own catalog item
+            self.catalog.create(
+                CatalogItem(
+                    name=plan.name,
+                    kind="source",
+                    schema=Schema([]),
+                    definition={"generator": plan.generator},
+                ),
+                or_replace=True,
+            )
+        src.start()
+        return ExecuteResult("ok")
+
+    # -- sinks ---------------------------------------------------------------
+    def _sequence_create_sink(
+        self, plan: CreateSinkPlan, sql, replay, record
+    ) -> ExecuteResult:
+        """CREATE SINK name FROM obj INTO KAFKA (BROKER ..., TOPIC ...,
+        FORMAT ..., ENVELOPE ...): exactly-once publication of the
+        object's update stream (storage/src/sink/kafka.rs analog; the
+        transaction is the broker's atomic multi-topic append)."""
+        from ..storage.kafka.broker import FileBroker
+        from ..storage.kafka.sink import KafkaSink
+
+        opts = {
+            str(k).lower().replace(" ", "_"): v
+            for k, v in plan.options.items()
+        }
+        it = self.catalog.items.get(plan.from_obj)
+        if it is None:
+            raise PlanError(f"unknown relation {plan.from_obj!r}")
+        shard = (
+            it.definition.get("shard")
+            if isinstance(it.definition, dict)
+            else None
+        )
+        if shard is None:
+            raise PlanError(
+                f"{plan.from_obj!r} has no durable collection to sink "
+                "(sink from a TABLE, SOURCE, or MATERIALIZED VIEW)"
+            )
+        broker_path = opts.get("broker")
+        topic = opts.get("topic")
+        if not broker_path or not topic:
+            raise PlanError("KAFKA sinks require BROKER and TOPIC")
+        if not replay:
+            self._check_name_free(plan.name)
+            # Validate EVERYTHING that can fail BEFORE the durable
+            # record (same invariant as sources: a poison record bricks
+            # every future boot): encoder construction catches unknown
+            # formats and avro-without-registry; FileBroker validates
+            # the path is creatable.
+            try:
+                from ..storage.kafka.decode import make_encoder
+
+                make_encoder(
+                    str(opts.get("format", "json")),
+                    it.schema,
+                    opts.get("registry"),
+                )
+                FileBroker(str(broker_path))
+            except Exception as e:
+                raise PlanError(str(e)) from e
+        if record is None:
+            record = self._record_ddl(sql, {"name": plan.name})
+        sink = KafkaSink(
+            self.persist,
+            shard,
+            it.schema,
+            FileBroker(str(broker_path)),
+            str(topic),
+            fmt=str(opts.get("format", "json")),
+            envelope=str(opts.get("envelope", "none")),
+            registry=opts.get("registry"),
+            sink_id=f"u{record['id']}",
+        )
+        self.sinks[plan.name] = sink
         self.catalog.create(
             CatalogItem(
                 name=plan.name,
-                kind="source",
-                schema=Schema([]),
-                definition={"generator": plan.generator},
-            ),
-            or_replace=True,
+                kind="sink",
+                schema=it.schema,
+                definition={
+                    "on": plan.from_obj,
+                    "topic": str(topic),
+                    "shard": shard,
+                },
+            )
         )
-        src.start()
+        if self.tick_interval is not None:
+            sink.start(self.tick_interval)
         return ExecuteResult("ok")
 
     # -- tables --------------------------------------------------------------
@@ -503,7 +601,7 @@ class Coordinator:
             for pos, raw in zip(positions, parts):
                 col = it.schema.columns[pos]
                 row[pos] = (
-                    None if raw is None else _parse_text_value(raw, col)
+                    None if raw is None else parse_text_value(raw, col)
                 )
             for v, col in zip(row, it.schema.columns):
                 if v is None and not col.nullable:
@@ -884,8 +982,10 @@ class Coordinator:
         "source": {"source"},
         "index": {"index"},
         "table": {"table"},
+        "sink": {"sink"},
         "object": {
             "view", "materialized-view", "source", "index", "table",
+            "sink",
         },
     }
 
@@ -936,7 +1036,13 @@ class Coordinator:
             self._webhooks.pop(name, None)
         elif it.kind == "table":
             self._table_writers.pop(name, None)
-        self.catalog.drop(name)
+        elif it.kind == "sink":
+            snk = self.sinks.pop(name, None)
+            if snk is not None:
+                snk.stop()
+        # if_exists: a kafka source's own item IS one of its subsources,
+        # already dropped by the loop above
+        self.catalog.drop(name, if_exists=True)
         return ExecuteResult("ok")
 
     # -- peeks ---------------------------------------------------------------
@@ -1071,6 +1177,8 @@ class Coordinator:
             sub.close()
         for src in self.sources.values():
             src.stop()
+        for snk in self.sinks.values():
+            snk.stop()
         self.controller.shutdown()
 
 
@@ -1115,48 +1223,6 @@ class Subscription:
         self.coord.controller.drop_dataflow(self.df_name)
         self.coord._df_upstream.pop(self.df_name, None)
         self.reader.expire()
-
-
-def _parse_text_value(raw: str, col: Column):
-    """pg COPY text-format field -> python value for the column type."""
-    import datetime as _dt
-    import decimal as _dec
-
-    t = col.ctype
-    try:
-        if t is ColumnType.BOOL:
-            s = raw.strip().lower()
-            if s in ("t", "true", "1", "yes", "on"):
-                return True
-            if s in ("f", "false", "0", "no", "off"):
-                return False
-            raise ValueError(raw)
-        if t in (ColumnType.INT32, ColumnType.INT64):
-            return int(raw)
-        if t is ColumnType.FLOAT64:
-            return float(raw)
-        if t is ColumnType.DECIMAL:
-            return _dec.Decimal(raw)
-        if t is ColumnType.DATE:
-            s = raw.strip()
-            if s.lstrip("-").isdigit():
-                return int(s)  # days-since-epoch shorthand
-            return (
-                _dt.date.fromisoformat(s) - _dt.date(1970, 1, 1)
-            ).days
-        if t is ColumnType.TIMESTAMP:
-            s = raw.strip()
-            if s.lstrip("-").isdigit():
-                return int(s)  # ms-since-epoch shorthand
-            dt = _dt.datetime.fromisoformat(s.replace("T", " "))
-            return int(
-                (dt - _dt.datetime(1970, 1, 1)).total_seconds() * 1000
-            )
-        return raw
-    except (ValueError, _dec.InvalidOperation) as exc:
-        raise PlanError(
-            f"invalid {t.value} value {raw!r} for column {col.name!r}"
-        ) from exc
 
 
 def _coerce_internal(v, from_col: Column, to_col: Column):

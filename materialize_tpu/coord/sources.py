@@ -320,16 +320,22 @@ class DatumsAdapter(GeneratorAdapter):
         }
 
 
-class KafkaAdapter(GeneratorAdapter):
-    """Gated: Kafka needs librdkafka, which is not in this build. The
-    CREATE SOURCE surface exists so catalogs referencing Kafka fail
-    with a clear, actionable error instead of a parse error."""
+def KafkaAdapter(options: dict):
+    """Broker-backed source factory (storage/src/source/kafka.rs
+    analog): the broker is the file-backed partitioned log in
+    storage/kafka/broker.py (librdkafka is not in this build; real
+    Kafka would implement the same Broker interface). Declared columns
+    are required: CREATE SOURCE s (a int, b text) FROM KAFKA (BROKER
+    '...', TOPIC '...', FORMAT 'json', ENVELOPE 'upsert')."""
+    from ..storage.kafka.source import KafkaSourceAdapter
 
-    def __init__(self, options: dict):
+    schema = options.get("_schema")
+    if schema is None:
         raise ValueError(
-            "KAFKA sources require librdkafka, which is not available "
-            "in this build; use a LOAD GENERATOR or WEBHOOK source"
+            "KAFKA sources require declared columns: "
+            "CREATE SOURCE name (col type, ...) FROM KAFKA (...)"
         )
+    return KafkaSourceAdapter(options, schema)
 
 
 GENERATORS = {
@@ -382,6 +388,29 @@ class GeneratorSource:
         if self.t == 0:
             self._append_all(self.adapter.snapshot(), 0)
             self.t = 1
+        elif hasattr(self.adapter, "recover_from_shards"):
+            # External sources (kafka) resume from their own durable
+            # output: the __remap subsource binds consumed offsets, and
+            # envelope state rehydrates from the emitted collection
+            # (the persist-rehydration model, not a state sidecar).
+            snapshots = {}
+            for sub, shard in self.shards.items():
+                reader = client.open_reader(shard, f"src-recover-{sub}")
+                try:
+                    _sch, cols, nulls, _t, diff = reader.snapshot(
+                        self.t - 1
+                    )
+                finally:
+                    reader.expire()
+                from ..repr.schema import decode_result_rows
+
+                rows = decode_result_rows(
+                    self.adapter.subsources[sub], cols, nulls, _t, diff
+                )
+                snapshots[sub] = [
+                    (r[:-2], r[-1]) for r in rows
+                ]
+            self.adapter.recover_from_shards(snapshots, self.t)
         elif hasattr(self.adapter, "recover"):
             # Stateful generators rebuild internal state by replaying
             # their deterministic stream to the durable frontier.

@@ -192,3 +192,47 @@ def decode_result_rows(schema: Schema, cols, nulls, time, diff) -> list:
                 vals.append(cols[j][i].item())
         out.append(tuple(vals) + (int(time[i]), int(diff[i])))
     return out
+
+
+def parse_text_value(raw: str, col: Column):
+    """pg COPY text-format field -> python value for the column type."""
+    import datetime as _dt
+    import decimal as _dec
+
+    t = col.ctype
+    try:
+        if t is ColumnType.BOOL:
+            s = raw.strip().lower()
+            if s in ("t", "true", "1", "yes", "on"):
+                return True
+            if s in ("f", "false", "0", "no", "off"):
+                return False
+            raise ValueError(raw)
+        if t in (ColumnType.INT32, ColumnType.INT64):
+            return int(raw)
+        if t is ColumnType.FLOAT64:
+            return float(raw)
+        if t is ColumnType.DECIMAL:
+            return _dec.Decimal(raw)
+        if t is ColumnType.DATE:
+            s = raw.strip()
+            if s.lstrip("-").isdigit():
+                return int(s)  # days-since-epoch shorthand
+            return (
+                _dt.date.fromisoformat(s) - _dt.date(1970, 1, 1)
+            ).days
+        if t is ColumnType.TIMESTAMP:
+            s = raw.strip()
+            if s.lstrip("-").isdigit():
+                return int(s)  # ms-since-epoch shorthand
+            dt = _dt.datetime.fromisoformat(s.replace("T", " "))
+            return int(
+                (dt - _dt.datetime(1970, 1, 1)).total_seconds() * 1000
+            )
+        return raw
+    except (ValueError, _dec.InvalidOperation) as exc:
+        # ValueError here; callers in the SQL layer surface it as a
+        # PlanError-compatible statement failure
+        raise ValueError(
+            f"invalid {t.value} value {raw!r} for column {col.name!r}"
+        ) from exc

@@ -533,6 +533,7 @@ class PgConnection:
             _msg(b"G", struct.pack("!bh", 0, n) + b"\x00\x00" * n)
         )
         chunks: list = []
+        saw_sync = False
         while True:
             tag = self._recv_exact(1)
             (length,) = struct.unpack("!I", self._recv_exact(4))
@@ -546,7 +547,12 @@ class PgConnection:
                     "COPY aborted by client: "
                     + payload.rstrip(b"\x00").decode()
                 )
-            elif tag in (b"H", b"S"):  # Flush/Sync are legal no-ops here
+            elif tag == b"S":
+                # a pipelined Sync (extended-protocol batch) arrives
+                # before the copy stream: owe its ReadyForQuery after
+                # the copy completes
+                saw_sync = True
+            elif tag == b"H":  # Flush: no-op
                 continue
             else:
                 raise ValueError(
@@ -569,6 +575,9 @@ class PgConnection:
             )
         count = self.coord.copy_in_rows(res.table, res.columns, rows)
         self._complete(f"COPY {count}")
+        if saw_sync:
+            self._skip_until_sync = False
+            self._ready()
 
     def _stream_subscription(self, res) -> None:
         """SUBSCRIBE over the COPY-out subprotocol: one text line per

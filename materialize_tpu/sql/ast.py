@@ -268,7 +268,19 @@ class CreateIndex(Statement):
 @dataclass(frozen=True)
 class CreateSource(Statement):
     name: str
-    generator: str  # tpch/auction/counter
+    generator: str  # tpch/auction/counter/... or "kafka"
+    options: dict = field(default_factory=dict)
+    # declared columns for external-format sources (kafka):
+    # (name, type_name, nullable) triples, like CreateTable
+    columns: tuple = ()
+
+
+@dataclass(frozen=True)
+class CreateSink(Statement):
+    """CREATE SINK name FROM obj INTO KAFKA (options...)."""
+
+    name: str
+    from_obj: str
     options: dict = field(default_factory=dict)
 
 

@@ -161,10 +161,24 @@ class Parser:
                 self.expect_sym(")")
             if self.accept_kw("to"):
                 self.expect_kw("stdout")
-                sel = ", ".join(cols) if cols else "*"
-                return ast.CopyTo(
-                    Parser(f"SELECT {sel} FROM {table}").parse_query()
+                # build the query as AST (no SQL-text round trip: quoted
+                # / case-preserving identifiers must survive)
+                items = (
+                    tuple(
+                        ast.SelectItem(ast.Ident((c,))) for c in cols
+                    )
+                    if cols
+                    else (ast.SelectItem(ast.Star()),)
                 )
+                q = ast.Query(
+                    ast.SelectExpr(
+                        ast.Select(
+                            items,
+                            (ast.FromItem(ast.TableName(table)),),
+                        )
+                    )
+                )
+                return ast.CopyTo(q)
             self.expect_kw("from")
             self.expect_kw("stdin")
             # optional WITH (FORMAT TEXT) — text is the only format
@@ -221,6 +235,8 @@ class Parser:
             return self._create_view(materialized=False, or_replace=or_replace)
         if self.accept_kw("source"):
             return self._create_source()
+        if self.accept_kw("sink"):
+            return self._create_sink()
         if self.accept_kw("table"):
             return self._create_table()
         if self.accept_kw("default"):
@@ -319,48 +335,75 @@ class Parser:
 
     def _create_source(self):
         name = self.expect_ident()
+        columns: tuple = ()
+        if self.peek().text == "(":
+            columns = self._column_defs()
         self.expect_kw("from")
         if self.peek().text == "webhook":
             self.next()
+            if columns:
+                raise ParseError(
+                    "webhook columns go after FROM WEBHOOK"
+                )
             return ast.CreateWebhook(name, self._column_defs())
+        if self.peek().text == "kafka":
+            self.next()
+            options = self._source_options()
+            return ast.CreateSource(name, "kafka", options, columns)
         self.expect_kw("load")
         self.expect_kw("generator")
         gen = self.expect_ident()
+        return ast.CreateSource(name, gen, self._source_options())
+
+    def _source_options(self) -> dict:
+        """'(' KEY [WORDS...] value, ... ')' — shared by LOAD GENERATOR,
+        KAFKA sources, and sinks (SCALE FACTOR 0.1 / TOPIC 'events')."""
         options: dict = {}
-        if self.accept_sym("("):
-            while True:
-                key_parts = [self.expect_ident()]
-                while self.peek().kind in (TokKind.IDENT, TokKind.KEYWORD) \
-                        and not self.peek().is_kw("for"):
-                    # multi-word option names (SCALE FACTOR, TICK INTERVAL)
-                    if self.peek().kind is TokKind.SYMBOL:
-                        break
-                    nxt = self.peek()
-                    if nxt.kind is TokKind.SYMBOL:
-                        break
-                    if nxt.text in (",",):
-                        break
-                    # value follows as number/string; stop if next is value
-                    if nxt.kind is TokKind.IDENT and len(key_parts) >= 2:
-                        break
-                    if nxt.kind in (TokKind.NUMBER, TokKind.STRING):
-                        break
-                    key_parts.append(self.expect_ident())
-                key = " ".join(key_parts)
-                t = self.peek()
-                if t.kind is TokKind.NUMBER:
-                    self.next()
-                    val = float(t.text) if "." in t.text else int(t.text)
-                elif t.kind is TokKind.STRING:
-                    self.next()
-                    val = t.text
-                else:
-                    val = True
-                options[key] = val
-                if not self.accept_sym(","):
+        if not self.accept_sym("("):
+            return options
+        while True:
+            key_parts = [self.expect_ident()]
+            while self.peek().kind in (TokKind.IDENT, TokKind.KEYWORD) \
+                    and not self.peek().is_kw("for"):
+                # multi-word option names (SCALE FACTOR, TICK INTERVAL)
+                if self.peek().kind is TokKind.SYMBOL:
                     break
-            self.expect_sym(")")
-        return ast.CreateSource(name, gen, options)
+                nxt = self.peek()
+                if nxt.kind is TokKind.SYMBOL:
+                    break
+                if nxt.text in (",",):
+                    break
+                # value follows as number/string; stop if next is value
+                if nxt.kind is TokKind.IDENT and len(key_parts) >= 2:
+                    break
+                if nxt.kind in (TokKind.NUMBER, TokKind.STRING):
+                    break
+                key_parts.append(self.expect_ident())
+            key = " ".join(key_parts)
+            t = self.peek()
+            if t.kind is TokKind.NUMBER:
+                self.next()
+                val = float(t.text) if "." in t.text else int(t.text)
+            elif t.kind is TokKind.STRING:
+                self.next()
+                val = t.text
+            else:
+                val = True
+            options[key] = val
+            if not self.accept_sym(","):
+                break
+        self.expect_sym(")")
+        return options
+
+    def _create_sink(self):
+        name = self.expect_ident()
+        self.expect_kw("from")
+        from_obj = self.expect_ident()
+        self.expect_kw("into")
+        if self.peek().text != "kafka":
+            raise ParseError("CREATE SINK supports INTO KAFKA")
+        self.next()
+        return ast.CreateSink(name, from_obj, self._source_options())
 
     def _drop(self):
         kind = self.expect_ident()

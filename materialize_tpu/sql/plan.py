@@ -56,6 +56,16 @@ class CreateSourcePlan(Plan):
     name: str
     generator: str
     options: dict
+    # declared Schema for external-format sources (kafka); None for
+    # generators whose schemas are intrinsic
+    schema: object = None
+
+
+@dataclass
+class CreateSinkPlan(Plan):
+    name: str
+    from_obj: str
+    options: dict
 
 
 @dataclass
@@ -172,7 +182,14 @@ def _plan(stmt: ast.Statement, catalog: CatalogInterface) -> Plan:
             stmt.name or f"{stmt.on}_primary_idx", stmt.on
         )
     if isinstance(stmt, ast.CreateSource):
-        return CreateSourcePlan(stmt.name, stmt.generator, stmt.options)
+        return CreateSourcePlan(
+            stmt.name,
+            stmt.generator,
+            stmt.options,
+            _table_schema(stmt.columns) if stmt.columns else None,
+        )
+    if isinstance(stmt, ast.CreateSink):
+        return CreateSinkPlan(stmt.name, stmt.from_obj, stmt.options)
     if isinstance(stmt, ast.DropObject):
         return DropPlan(stmt.kind, stmt.name, stmt.if_exists)
     if isinstance(stmt, ast.CreateTable):
