@@ -1106,7 +1106,9 @@ class Coordinator:
         rows = _decode_peek_rows(df.output.batch)
         return ExecuteResult(
             "rows",
-            rows=_finish(rows, plan.order_by),
+            rows=_finish(rows, plan.order_by,
+                         getattr(plan, "limit", None),
+                         getattr(plan, "offset", 0)),
             columns=plan.column_names,
             schema=expr.schema(),
         )
@@ -1133,7 +1135,9 @@ class Coordinator:
                 )
             return ExecuteResult(
                 "rows",
-                rows=_finish(rows, plan.order_by),
+                rows=_finish(rows, plan.order_by,
+                         getattr(plan, "limit", None),
+                         getattr(plan, "offset", 0)),
                 columns=plan.column_names,
                 schema=expr.schema(),
             )
@@ -1142,7 +1146,9 @@ class Coordinator:
         rows = self._transient_peek(expr, unlocked=True)
         return ExecuteResult(
             "rows",
-            rows=_finish(rows, plan.order_by),
+            rows=_finish(rows, plan.order_by,
+                         getattr(plan, "limit", None),
+                         getattr(plan, "offset", 0)),
             columns=plan.column_names,
             schema=expr.schema(),
         )
@@ -1258,7 +1264,8 @@ def _coerce_internal(v, from_col: Column, to_col: Column):
     return int(v)
 
 
-def _finish(rows: list, order_by: tuple = ()) -> list:
+def _finish(rows: list, order_by: tuple = (), limit=None,
+            offset: int = 0) -> list:
     """Collapse (cols..., time, diff) into SELECT result rows with
     multiplicities expanded and the query's ORDER BY applied
     (RowSetFinishing application, coord/peek.rs:910). Without an ORDER
@@ -1301,6 +1308,10 @@ def _finish(rows: list, order_by: tuple = ()) -> list:
                 "(non-monotonic input to a raw SELECT?)"
             )
         out.extend([vals] * mult)
+    if offset:
+        out = out[offset:]
+    if limit is not None:
+        out = out[: int(limit)]
     return out
 
 

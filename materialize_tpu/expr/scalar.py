@@ -225,6 +225,41 @@ class BinaryFunc:
     GTE = "gte"
 
 
+# String functions evaluate as dictionary side-table gathers (see
+# expr/strings.py): CallVariadic("str:<fn>", (col, literal params...)).
+STRING_FUNC_PREFIX = "str:"
+
+
+def string_call(func: str, expr: "ScalarExpr", *params) -> "CallVariadic":
+    return CallVariadic(
+        STRING_FUNC_PREFIX + func, (expr,) + tuple(params)
+    )
+
+
+def _string_func_key(func: str, param_exprs) -> str:
+    """Trace/render-time env key: literal params decoded to text."""
+    from ..repr.schema import GLOBAL_DICT
+    from . import strings
+
+    vals = []
+    for p in param_exprs:
+        if not isinstance(p, Literal):
+            raise NotImplementedError(
+                f"{func}: non-literal string-function arguments are "
+                "not supported (the mapping table is precomputed per "
+                "distinct dictionary entry)"
+            )
+        if p.value is None:
+            raise NotImplementedError(
+                f"{func}: NULL parameters are not supported"
+            )
+        if p.ctype is ColumnType.STRING:
+            vals.append(GLOBAL_DICT.decode(int(p.value)))
+        else:
+            vals.append(p.value)
+    return strings.env_key(func, *vals)
+
+
 class VariadicFunc:
     AND = "and"
     OR = "or"
@@ -331,6 +366,26 @@ class CallVariadic(ScalarExpr):
         object.__setattr__(self, "exprs", tuple(exprs))
 
     def typ(self, schema):
+        if self.func.startswith(STRING_FUNC_PREFIX):
+            from . import strings
+
+            kind = strings.RESULT_KINDS[
+                self.func[len(STRING_FUNC_PREFIX):]
+            ]
+            inner = self.exprs[0].typ(schema)
+            if inner.ctype is not ColumnType.STRING:
+                # gathering a non-code column through a dictionary
+                # table would silently produce unrelated strings
+                raise TypeError(
+                    f"{self.func} requires a text operand, got "
+                    f"{inner.ctype.value}"
+                )
+            ctype = {
+                "str": ColumnType.STRING,
+                "int": ColumnType.INT64,
+                "bool": ColumnType.BOOL,
+            }[kind]
+            return Column("f", ctype, inner.nullable)
         if self.func in (VariadicFunc.AND, VariadicFunc.OR):
             nullable = any(e.typ(schema).nullable for e in self.exprs)
             return Column("f", ColumnType.BOOL, nullable)
@@ -572,7 +627,21 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
             BinaryFunc.GT,
             BinaryFunc.GTE,
         ):
-            lv, rv = _coerce_comparable(l, r)
+            if (
+                l.col.ctype is ColumnType.STRING
+                and r.col.ctype is ColumnType.STRING
+                and f not in (BinaryFunc.EQ, BinaryFunc.NEQ)
+            ):
+                # dictionary codes are insertion-ordered; ordering
+                # comparisons go through the lexicographic rank table
+                from . import strings
+
+                rank = strings.trace_env()["rank"]
+                hi = rank.shape[0] - 1
+                lv = rank[jnp.clip(l.values, 0, hi)]
+                rv = rank[jnp.clip(r.values, 0, hi)]
+            else:
+                lv, rv = _coerce_comparable(l, r)
             op = {
                 BinaryFunc.EQ: jnp.equal,
                 BinaryFunc.NEQ: jnp.not_equal,
@@ -667,6 +736,15 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
 
     if isinstance(expr, CallVariadic):
         col = expr.typ(schema)
+        if expr.func.startswith(STRING_FUNC_PREFIX):
+            from . import strings
+
+            fn = expr.func[len(STRING_FUNC_PREFIX):]
+            key = _string_func_key(fn, expr.exprs[1:])
+            e = eval_expr(expr.exprs[0], batch, time)
+            table = strings.trace_env()[key]
+            vals = table[jnp.clip(e.values, 0, table.shape[0] - 1)]
+            return Evaled(vals, e.nulls, col)
         parts = [eval_expr(e, batch, time) for e in expr.exprs]
         if expr.func == VariadicFunc.AND:
             # SQL 3VL: FALSE dominates NULL

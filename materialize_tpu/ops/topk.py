@@ -50,13 +50,19 @@ def order_lane_arrays(batch: Batch, order_by) -> list[jnp.ndarray]:
     lanes = []
     for col_idx, desc, nulls_last in order_by:
         col = batch.schema[col_idx]
-        if not col.ctype.is_orderable_on_device:
-            raise NotImplementedError(
-                f"ORDER BY on {col.ctype} (dictionary codes are not "
-                "order-preserving)"
-            )
         arr = batch.cols[col_idx]
         nulls = batch.nulls[col_idx]
+        if col.ctype is ColumnType.STRING:
+            # TopK state persists order lanes across steps and keys the
+            # arrangement on them; string ranks SHIFT as the dictionary
+            # grows, so rank-derived lanes would break retraction
+            # matching. ORDER BY text works at result finishing
+            # (host-side, coord _finish); device TopK over text awaits
+            # per-step lane recomputation.
+            raise NotImplementedError(
+                "TopK/LIMIT ordered by a text column is not supported "
+                "on device; ORDER BY text without LIMIT is fine"
+            )
         val_lanes = list(column_lanes(arr, col.ctype))
         if desc:
             val_lanes = [~l for l in val_lanes]

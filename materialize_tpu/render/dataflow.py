@@ -801,14 +801,30 @@ class _DataflowBase:
             self._first_time = int(self.time)
             self._ctx.first_time = self._first_time
         packed = [self._pack_inputs(i) for i in inputs_list]
+        env = None
+        if getattr(self, "_str_keys", None):
+            # dictionary side-tables for string functions: built once
+            # per span (inputs are already encoded, so the dictionary
+            # is stable across the span's steps)
+            from ..expr import strings
+
+            env = strings.build_env(
+                self._str_keys, getattr(self, "_str_depth", 1)
+            )
         while True:
             ck = (list(self.states), self.output, self.time)
             deltas, flags = [], []
             for p in packed:
                 t = jnp.asarray(self.time, dtype=jnp.uint64)
-                out, new_states, new_output, fl = self._step_jit(
-                    tuple(self.states), self.output, p, t
-                )
+                args = (tuple(self.states), self.output, p, t)
+                if env is not None:
+                    out, new_states, new_output, fl = self._step_jit(
+                        *args, env
+                    )
+                else:
+                    out, new_states, new_output, fl = self._step_jit(
+                        *args
+                    )
                 self.states = list(new_states)
                 self.output = new_output
                 self.time += 1
@@ -837,9 +853,12 @@ class Dataflow(_DataflowBase):
     """
 
     def __init__(self, expr: mir.RelationExpr, name: str = "df"):
+        from ..expr import strings
+
         self.expr = expr
         self.name = name
         self.out_schema = expr.schema()
+        self._str_keys, self._str_depth = strings.collect_keys(expr)
         ctx = _RenderContext({})
         self._run = _build(expr, ctx)
         self._ctx = ctx
@@ -850,10 +869,19 @@ class Dataflow(_DataflowBase):
 
     def _remake_jit(self):
         # A fresh jit wrapper so trace-time reads of mutable ctx tiers
-        # (join_caps, slot_cap) take effect after growth.
-        self._step_jit = jax.jit(
-            lambda s, o, i, t: self._step_core(s, o, i, t)
-        )
+        # (join_caps, slot_cap) take effect after growth. Dataflows
+        # whose expressions use string functions carry the dictionary
+        # side-tables as an extra jit input (expr/strings.py); others
+        # keep the 4-argument signature (and their compile-cache
+        # entries).
+        if self._str_keys:
+            self._step_jit = jax.jit(
+                lambda s, o, i, t, env: self._step_core(s, o, i, t, env)
+            )
+        else:
+            self._step_jit = jax.jit(
+                lambda s, o, i, t: self._step_core(s, o, i, t)
+            )
 
     def _grow_arrangement(self, arr: Arrangement) -> Arrangement:
         return Arrangement(
@@ -864,7 +892,13 @@ class Dataflow(_DataflowBase):
         return inputs
 
     # pure, jitted once per capacity signature
-    def _step_core(self, states, output, inputs, time):
+    def _step_core(self, states, output, inputs, time, env=None):
+        from ..expr import strings
+
+        with strings.trace_scope(env if env is not None else {}):
+            return self._step_core_inner(states, output, inputs, time)
+
+    def _step_core_inner(self, states, output, inputs, time):
         out, upd, ovf = self._run(states, inputs, time)
         new_states = list(states)
         for k, v in upd.items():
@@ -919,9 +953,12 @@ class ShardedDataflow(_DataflowBase):
     def __init__(self, expr: mir.RelationExpr, mesh, name: str = "df",
                  slot_cap: int = 256, input_shard_cap: int = 1024,
                  output_cap: int = 256):
+        from ..expr import strings
+
         self.expr = expr
         self.mesh = mesh
         self.name = name
+        self._str_keys, self._str_depth = strings.collect_keys(expr)
         if len(mesh.axis_names) != 1:
             raise ValueError(
                 "ShardedDataflow wants a 1-D worker mesh (make_mesh); "
@@ -1023,14 +1060,7 @@ class ShardedDataflow(_DataflowBase):
                 for a in s
             )
 
-        def per_worker(states, output, inputs, time):
-            # Leaves arrive rank-preserved: counts are [1]; make scalar.
-            states = [scalar_counts(s) for s in states]
-            (output,) = scalar_counts((output,))
-            inputs = {
-                k: b.replace(count=b.count.reshape(()))
-                for k, b in inputs.items()
-            }
+        def body(states, output, inputs, time):
             out, upd, ovf = self._run(states, inputs, time)
             new_states = list(states)
             for k, v in upd.items():
@@ -1054,16 +1084,45 @@ class ShardedDataflow(_DataflowBase):
             (new_output,) = vec_counts((new_output,))
             return out, new_states, new_output, flags
 
-        def step(states, output, inputs, time):
-            return jax.shard_map(
-                per_worker,
-                mesh=self.mesh,
-                in_specs=(P(self.axis_name), P(self.axis_name),
-                          P(self.axis_name), P()),
-                out_specs=(P(self.axis_name), P(self.axis_name),
-                           P(self.axis_name), P(None, self.axis_name)),
-                check_vma=False,
-            )(states, output, inputs, time)
+        def per_worker(states, output, inputs, time, env=None):
+            from ..expr import strings
+
+            # Leaves arrive rank-preserved: counts are [1]; make scalar.
+            states = [scalar_counts(s) for s in states]
+            (output,) = scalar_counts((output,))
+            inputs = {
+                k: b.replace(count=b.count.reshape(()))
+                for k, b in inputs.items()
+            }
+            with strings.trace_scope(env if env is not None else {}):
+                return body(states, output, inputs, time)
+
+        if self._str_keys:
+            # env (the string side-tables) rides along REPLICATED: every
+            # worker gathers through identical dictionaries
+            def step(states, output, inputs, time, env):
+                return jax.shard_map(
+                    per_worker,
+                    mesh=self.mesh,
+                    in_specs=(P(self.axis_name), P(self.axis_name),
+                              P(self.axis_name), P(), P()),
+                    out_specs=(P(self.axis_name), P(self.axis_name),
+                               P(self.axis_name),
+                               P(None, self.axis_name)),
+                    check_vma=False,
+                )(states, output, inputs, time, env)
+        else:
+            def step(states, output, inputs, time):
+                return jax.shard_map(
+                    lambda s, o, i, t: per_worker(s, o, i, t),
+                    mesh=self.mesh,
+                    in_specs=(P(self.axis_name), P(self.axis_name),
+                              P(self.axis_name), P()),
+                    out_specs=(P(self.axis_name), P(self.axis_name),
+                               P(self.axis_name),
+                               P(None, self.axis_name)),
+                    check_vma=False,
+                )(states, output, inputs, time)
 
         self._step_jit = jax.jit(step)
 

@@ -75,6 +75,14 @@ class IsNull(Expr):
 
 
 @dataclass(frozen=True)
+class Like(Expr):
+    expr: Expr
+    pattern: Expr  # must plan to a string literal
+    negated: bool = False
+    case_insensitive: bool = False  # ILIKE
+
+
+@dataclass(frozen=True)
 class InList(Expr):
     expr: Expr
     items: tuple

@@ -26,7 +26,7 @@ KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "offset", "asc", "desc", "nulls", "first", "last", "as", "on", "using",
     "join", "inner", "left", "right", "full", "outer", "cross", "and",
-    "or", "not", "in", "exists", "between", "like", "is", "null", "true",
+    "or", "not", "in", "exists", "between", "like", "ilike", "is", "null", "true",
     "false", "case", "when", "then", "else", "end", "cast", "distinct",
     "union", "all", "except", "intersect", "with", "recursive", "mutually",
     "create", "drop", "view", "materialized", "index", "source", "sink",

@@ -26,7 +26,7 @@ _BINARY_PREC = {
     "and": 20,
     # NOT handled as prefix at 25
     "=": 40, "<>": 40, "!=": 40, "<": 40, "<=": 40, ">": 40, ">=": 40,
-    "like": 40, "between": 40, "in": 40, "is": 40,
+    "like": 40, "ilike": 40, "between": 40, "in": 40, "is": 40,
     "||": 50,
     "+": 60, "-": 60,
     "*": 70, "/": 70, "%": 70,
@@ -631,7 +631,8 @@ class Parser:
             if t.kind is TokKind.SYMBOL and t.text in _BINARY_PREC:
                 op = t.text
             elif t.kind is TokKind.KEYWORD and t.text in (
-                "and", "or", "like", "between", "in", "is", "not",
+                "and", "or", "like", "ilike", "between", "in", "is",
+                "not",
             ):
                 op = t.text
             if op is None:
@@ -641,7 +642,7 @@ class Parser:
             if op == "not":
                 nxt = self.toks[self.i + 1]
                 if nxt.kind is TokKind.KEYWORD and nxt.text in (
-                    "in", "like", "between",
+                    "in", "like", "ilike", "between",
                 ):
                     negated = True
                     op = nxt.text
@@ -678,6 +679,9 @@ class Parser:
                     left = ast.InList(left, tuple(items), negated)
                 continue
             right = self.parse_expr(prec + 1)
+            if op in ("like", "ilike"):
+                left = ast.Like(left, right, negated, op == "ilike")
+                continue
             if op == "!=":
                 op = "<>"
             left = ast.BinaryOp(op, left, right)

@@ -12,7 +12,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..expr import relation as mir
-from ..repr.schema import Schema
+from ..repr.schema import ColumnType, Schema
 from . import ast
 from .hir import CatalogInterface, HirRelation, PlanError
 from .lowering import lower
@@ -31,6 +31,10 @@ class SelectPlan(Plan):
     # RowSetFinishing ordering: (col_idx, desc, nulls_last) triples,
     # applied adapter-side to peek results (coord/peek.rs:910 analog).
     order_by: tuple = ()
+    # host-side LIMIT/OFFSET finishing: used when a top-level TopK
+    # orders by a text column (device TopK cannot key on string ranks)
+    limit: object = None
+    offset: int = 0
     # COPY (query) TO STDOUT: stream the result over the COPY-out
     # subprotocol instead of DataRows
     copy_out: bool = False
@@ -163,11 +167,27 @@ def _plan(stmt: ast.Statement, catalog: CatalogInterface) -> Plan:
     qp = QueryPlanner(catalog)
     if isinstance(stmt, ast.SelectStatement):
         hir_rel, scope = qp.plan_query(stmt.query)
-        return SelectPlan(
-            lower(hir_rel),
+        m = lower(hir_rel)
+        plan = SelectPlan(
+            m,
             tuple(it.name for it in scope.items),
             getattr(qp, "finishing_order", ()),
         )
+        # A top-level LIMIT ordered by text cannot run as a device TopK
+        # (string ranks shift as the dictionary grows; ops/topk.py):
+        # strip it and finish host-side with the peek's RowSetFinishing.
+        if (
+            isinstance(m, mir.TopK)
+            and m.group_key == ()
+            and any(
+                m.input.schema()[i].ctype is ColumnType.STRING
+                for i, _, _ in m.order_by
+            )
+        ):
+            plan.expr = m.input
+            plan.limit = m.limit
+            plan.offset = m.offset
+        return plan
     if isinstance(stmt, ast.CreateView):
         hir_rel, scope = qp.plan_query(stmt.query)
         return CreateViewPlan(

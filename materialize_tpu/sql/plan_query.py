@@ -49,6 +49,28 @@ from .hir import (
     typ_of,
 )
 
+# string functions lower to dictionary side-table gathers
+# (expr/strings.py): HCallVariadic("str:<fn>", (col, literal params...))
+_STR = "str:"
+
+# name -> (env func, n_args incl. the string column, param positions)
+_STRING_FUNCS_1 = {
+    "upper": "upper",
+    "lower": "lower",
+    "initcap": "initcap",
+    "reverse": "reverse",
+    "length": "length",
+    "char_length": "length",
+    "character_length": "length",
+    "ascii": "ascii",
+    "bit_length": "bit_length",
+    "octet_length": "octet_length",
+    "trim": "trim",
+    "btrim": "trim",
+    "ltrim": "ltrim",
+    "rtrim": "rtrim",
+}
+
 _UNARY_FUNC_NAMES = {
     "abs": UnaryFunc.ABS,
     "floor": UnaryFunc.FLOOR,
@@ -447,6 +469,14 @@ class QueryPlanner:
                 aggs.append(HAggregate(func, inner, dist, out))
                 return ("plain", [len(aggs) - 1])
             if name in ("min", "max"):
+                if ityp.ctype is ColumnType.STRING:
+                    # hierarchical reduce state holds codes across steps
+                    # and the dictionary's rank shifts as it grows;
+                    # defer until the reduce kernels order via the rank
+                    # side-table per step
+                    raise PlanError(
+                        f"{name} over text is not yet supported"
+                    )
                 func = (
                     AggregateFunc.MIN if name == "min" else AggregateFunc.MAX
                 )
@@ -702,6 +732,8 @@ class QueryPlanner:
                         self.plan_expr(e.right, scope),
                     ),
                 )
+            if e.op == "||":
+                return self._plan_concat(e, scope)
             if e.op in _BINOPS:
                 return HCallBinary(
                     _BINOPS[e.op],
@@ -725,6 +757,20 @@ class QueryPlanner:
                 return HCallUnary(UnaryFunc.NEG, inner)
             if e.op == "not":
                 return HCallUnary(UnaryFunc.NOT, inner)
+        if isinstance(e, ast.Like):
+            x = self.plan_expr(e.expr, scope)
+            pat = self.plan_expr(e.pattern, scope)
+            if not (
+                isinstance(pat, HLiteral)
+                and pat.ctype is ColumnType.STRING
+            ):
+                raise PlanError(
+                    "LIKE patterns must be string literals (the match "
+                    "table is precomputed per dictionary entry)"
+                )
+            fn = "ilike" if e.case_insensitive else "like"
+            out = HCallVariadic(_STR + fn, (x, pat))
+            return HCallUnary(UnaryFunc.NOT, out) if e.negated else out
         if isinstance(e, ast.IsNull):
             inner = HCallUnary(
                 UnaryFunc.IS_NULL, self.plan_expr(e.expr, scope)
@@ -872,6 +918,11 @@ class QueryPlanner:
                 HLiteral(None, ColumnType.INT64),
                 a,
             )
+        if name in _STRING_FUNCS_1 or name in (
+            "substr", "substring", "left", "right", "replace", "lpad",
+            "rpad", "strpos", "position", "split_part",
+        ):
+            return self._plan_string_func(name, e, scope)
         if name in _UNARY_FUNC_NAMES:
             if len(e.args) != 1:
                 raise PlanError(f"{name} takes one argument")
@@ -911,6 +962,119 @@ class QueryPlanner:
 
             return HMzNow()
         raise PlanError(f"unknown function {name}")
+
+    def _require_literal(self, h, what: str) -> HLiteral:
+        if not isinstance(h, HLiteral):
+            raise PlanError(
+                f"{what} must be a literal (string-function parameters "
+                "are baked into the dictionary side-table)"
+            )
+        return h
+
+    def _plan_string_func(self, name: str, e: ast.FuncCall, scope):
+        """String function library (the dictionary-gather lowering;
+        reference: expr/src/scalar/func/impls/string.rs)."""
+        args = [self.plan_expr(a, scope) for a in e.args]
+        if name in _STRING_FUNCS_1:
+            if len(args) == 1:
+                return HCallVariadic(
+                    _STR + _STRING_FUNCS_1[name], (args[0],)
+                )
+            if name in ("trim", "btrim", "ltrim", "rtrim") and len(
+                args
+            ) == 2:
+                chars = self._require_literal(args[1], f"{name} chars")
+                return HCallVariadic(
+                    _STR + _STRING_FUNCS_1[name], (args[0], chars)
+                )
+            raise PlanError(f"wrong argument count for {name}")
+        def need(n_min: int, n_max: int):
+            if not (n_min <= len(args) <= n_max):
+                raise PlanError(
+                    f"wrong argument count for {name} "
+                    f"(got {len(args)})"
+                )
+
+        if name in ("substr", "substring"):
+            need(2, 3)
+            params = tuple(
+                self._require_literal(a, "substr bounds")
+                for a in args[1:]
+            )
+            return HCallVariadic(_STR + "substr", (args[0],) + params)
+        if name in ("left", "right"):
+            need(2, 2)
+            n = self._require_literal(args[1], f"{name} count")
+            return HCallVariadic(_STR + name, (args[0], n))
+        if name == "replace":
+            need(3, 3)
+            p = self._require_literal(args[1], "replace from")
+            q = self._require_literal(args[2], "replace to")
+            return HCallVariadic(_STR + "replace", (args[0], p, q))
+        if name in ("lpad", "rpad"):
+            need(2, 3)
+            params = tuple(
+                self._require_literal(a, f"{name} params")
+                for a in args[1:]
+            )
+            return HCallVariadic(_STR + name, (args[0],) + params)
+        if name in ("strpos", "position"):
+            need(2, 2)
+            sub = self._require_literal(args[1], "substring")
+            return HCallVariadic(_STR + "position", (args[0], sub))
+        if name == "split_part":
+            need(3, 3)
+            d = self._require_literal(args[1], "delimiter")
+            i = self._require_literal(args[2], "field index")
+            return HCallVariadic(_STR + "split_part", (args[0], d, i))
+        raise PlanError(f"unknown string function {name}")
+
+    def _plan_concat(self, e: ast.BinaryOp, scope):
+        """a || b: string concatenation. One side must be a literal
+        (the side-table maps each dictionary entry through the append);
+        literal||literal folds at plan time; column||column requires
+        materializing the cross product of dictionaries and is not
+        supported."""
+        left = self.plan_expr(e.left, scope)
+        right = self.plan_expr(e.right, scope)
+
+        def lit_text(h: HLiteral) -> str:
+            if h.ctype is ColumnType.STRING:
+                return GLOBAL_DICT.decode(int(h.value))
+            return str(h.value)
+
+        lish = isinstance(left, HLiteral)
+        rish = isinstance(right, HLiteral)
+        # NULL || anything is NULL (pg)
+        if (lish and left.value is None) or (
+            rish and right.value is None
+        ):
+            return HLiteral(None, ColumnType.STRING)
+        if lish and rish:
+            return HLiteral(
+                GLOBAL_DICT.encode(lit_text(left) + lit_text(right)),
+                ColumnType.STRING,
+            )
+        if rish:
+            return HCallVariadic(
+                _STR + "concat_r",
+                (left, HLiteral(
+                    GLOBAL_DICT.encode(lit_text(right)),
+                    ColumnType.STRING,
+                )),
+            )
+        if lish:
+            return HCallVariadic(
+                _STR + "concat_l",
+                (right, HLiteral(
+                    GLOBAL_DICT.encode(lit_text(left)),
+                    ColumnType.STRING,
+                )),
+            )
+        raise PlanError(
+            "column || column concatenation is not supported (one side "
+            "must be a literal; see expr/strings.py)"
+        )
 
 
 from dataclasses import dataclass
