@@ -43,10 +43,38 @@ jax.config.update("jax_enable_x64", True)
 # sub-millisecond to run. Caching compiled executables across processes
 # makes dataflow installation (the CREATE MATERIALIZED VIEW analog)
 # pay that cost once per (plan, capacity signature) per machine.
+#
+# The cache directory is keyed by a HOST FINGERPRINT (CPU feature set):
+# XLA:CPU emits ahead-of-time machine code, and loading an executable
+# compiled on a machine with different vector extensions is undefined —
+# observed as both "could lead to SIGILL" loader warnings and, worse,
+# silently wrong kernel results when a foreign-host cache was reused.
+
+
+def _host_fingerprint() -> str:
+    import hashlib
+    import platform
+
+    parts = [platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    parts.append(" ".join(sorted(line.split()[2:])))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:12]
+
+
 jax.config.update(
     "jax_compilation_cache_dir",
-    os.environ.get("MATERIALIZE_TPU_COMPILE_CACHE",
-                   os.path.expanduser("~/.cache/materialize_tpu_xla")),
+    os.environ.get(
+        "MATERIALIZE_TPU_COMPILE_CACHE",
+        os.path.expanduser(
+            f"~/.cache/materialize_tpu_xla/{_host_fingerprint()}"
+        ),
+    ),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 

@@ -17,7 +17,7 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
-from ..ops.consolidate import consolidate
+from ..ops.consolidate import consolidate, consolidate_sorted
 from ..ops.lanes import key_lanes
 from ..ops.merge import merge_sorted
 from ..ops.search import lex_searchsorted
@@ -107,15 +107,15 @@ def insert(
         d.sort_lanes(),
         out_capacity,
     )
-    # Merged runs may contain the same row twice (once per side);
-    # consolidate sums their diffs. Sort order is preserved by
-    # consolidate's stable full-row sort.
-    cons = consolidate(merged, include_time=False)
-    if arr.key == tuple(range(len(arr.key))):
-        return Arrangement(cons, arr.key), overflow
-    out = Arrangement(cons, arr.key)
-    perm = sort_perm(out.sort_lanes(), cons.count, cons.capacity)
-    return Arrangement(apply_perm(cons, perm), arr.key), overflow
+    # Merged runs may contain the same row twice (once per side); both
+    # sides are sorted by the arrangement's sort lanes, so the merge is
+    # too, and summing duplicate rows' diffs needs NO sort
+    # (consolidate_sorted) — the arrangement's maintenance cost compiles
+    # linearly in its capacity, so state can scale to 2^20+ rows while
+    # sorts stay confined to delta-sized batches (PERF_NOTES.md fact 4).
+    m = Arrangement(merged, arr.key)
+    cons = consolidate_sorted(merged, m.sort_lanes())
+    return Arrangement(cons, arr.key), overflow
 
 
 def lookup_range(arr: Arrangement, probe_lanes) -> tuple:

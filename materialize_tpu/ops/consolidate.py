@@ -25,6 +25,24 @@ def consolidate(batch: Batch, include_time: bool = True) -> Batch:
     sorted_batch = apply_perm(batch, perm)
     # Permute the already-computed lanes instead of re-encoding every column.
     lanes = [l[perm] for l in lanes]
+    return _consolidate_on_lanes(sorted_batch, lanes)
+
+
+def consolidate_sorted(batch: Batch, lanes) -> Batch:
+    """Consolidate a batch that is ALREADY sorted by `lanes`, where the
+    lanes cover every column (any full-row lexicographic order works:
+    equal rows are adjacent under any total order on all columns). No
+    sort — compile cost stays linear in capacity, which is what lets
+    arrangement state capacity scale to 2^20+ (XLA's TPU sort compile is
+    superlinear in rows; PERF_NOTES.md fact 4). The spine merge path
+    (`arrangement/spine.py insert`) is the intended caller: a merge of
+    two sorted runs is sorted, so its duplicate-row summation needs no
+    re-sort."""
+    return _consolidate_on_lanes(batch, lanes)
+
+
+def _consolidate_on_lanes(sorted_batch: Batch, lanes) -> Batch:
+    cap = sorted_batch.capacity
     starts = segment_starts(lanes, sorted_batch.count, cap)
     seg = segment_ids(starts)
     valid = sorted_batch.valid_mask()

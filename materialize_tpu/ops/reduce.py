@@ -142,15 +142,23 @@ def delta_contributions(
     )
 
 
-def sum_by_key(batch: Batch, n_key: int) -> Batch:
+def sum_by_key(batch: Batch, n_key: int, presorted: bool = False) -> Batch:
     """Sort by the first n_key columns and sum ALL remaining (accumulator)
     columns per key; drop groups whose accums are all untouched rows.
-    Output diff=1 per surviving group row."""
+    Output diff=1 per surviving group row.
+
+    ``presorted=True`` skips the sort for inputs already key-sorted
+    (e.g. the output of a merge of two key-sorted runs) — keeping the
+    state-capacity path free of sorts, whose TPU compile time is
+    superlinear in rows (PERF_NOTES.md fact 4)."""
     cap = batch.capacity
     lanes = key_lanes(batch, range(n_key))
-    perm = sort_perm(lanes, batch.count, cap)
-    s = apply_perm(batch, perm)
-    lanes = [l[perm] for l in lanes]
+    if presorted:
+        s = batch
+    else:
+        perm = sort_perm(lanes, batch.count, cap)
+        s = apply_perm(batch, perm)
+        lanes = [l[perm] for l in lanes]
     starts = segment_starts(lanes, s.count, cap)
     seg = segment_ids(starts)
     valid = s.valid_mask()
@@ -187,7 +195,7 @@ def merge_accum_state(
         key_lanes(groups, range(n_key)),
         out_capacity,
     )
-    summed = sum_by_key(merged, n_key)
+    summed = sum_by_key(merged, n_key, presorted=True)
     alive = summed.cols[n_key] != 0  # __rows__ > 0 (can't go negative)
     new_state = compact(summed, alive)
     return Arrangement(new_state, state.key), overflow
@@ -329,23 +337,17 @@ class ReduceOp:
     aggregates: tuple
 
     def __post_init__(self):
+        from ..plan.decisions import plan_reduce
+
         self.n_key = len(self.group_key)
-        unsupported = [
-            a.func
-            for a in self.aggregates
-            if not (a.func.is_accumulable or a.func.is_hierarchical)
-        ]
-        if unsupported:
-            raise NotImplementedError(f"aggregates {unsupported}")
+        # The accumulable/hierarchical partition comes from the plan
+        # layer so EXPLAIN PHYSICAL PLAN's ReducePlan is what executes.
+        self.plan = plan_reduce(self.aggregates)
         self.acc_aggs = tuple(
-            (j, a)
-            for j, a in enumerate(self.aggregates)
-            if a.func.is_accumulable
+            (j, self.aggregates[j]) for j in self.plan.accumulable
         )
         self.hier_aggs = tuple(
-            (j, a)
-            for j, a in enumerate(self.aggregates)
-            if a.func.is_hierarchical
+            (j, self.aggregates[j]) for j in self.plan.hierarchical
         )
         self.state_schema = accum_schema(
             self.input_schema,

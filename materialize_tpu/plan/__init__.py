@@ -1,0 +1,25 @@
+"""The physical plan layer (LIR): MIR→LIR lowering and plan decisions.
+
+Reference analog: the ``compute-types`` crate — ``LirRelationExpr``
+(plan.rs:208), plan decisions (plan/lowering.rs:338), and the per-operator
+plan enums (ReducePlan/TopKPlan/JoinPlan/ThresholdPlan).
+"""
+
+from .decisions import (  # noqa: F401
+    join_implementation,
+    join_stage_keys,
+    monotonic,
+    plan_join,
+    plan_reduce,
+    plan_threshold,
+    plan_topk,
+)
+from .lir import (  # noqa: F401
+    JoinPlan,
+    LinearStagePlan,
+    LirNode,
+    ReducePlan,
+    ThresholdPlan,
+    TopKPlan,
+)
+from .lowering import explain_lir, lower_mir  # noqa: F401

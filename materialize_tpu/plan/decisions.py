@@ -1,0 +1,171 @@
+"""The MIR→LIR plan decisions, shared by EXPLAIN and the render layer.
+
+Single source of truth: render/dataflow.py and ops/reduce.py import these
+functions, so the printed physical plan is exactly what executes
+(compute-types/src/plan/lowering.rs:338 is the reference analog — its
+decisions feed both EXPLAIN and rendering).
+"""
+
+from __future__ import annotations
+
+from ..expr import relation as mir
+from ..expr.relation import AggregateFunc
+from ..expr.scalar import ColumnRef
+from .lir import (
+    JoinPlan,
+    LinearStagePlan,
+    ReducePlan,
+    ThresholdPlan,
+    TopKPlan,
+)
+
+
+def plan_reduce(aggregates) -> ReducePlan:
+    """Partition aggregates into accumulable vs hierarchical and pick
+    the reduce plan (plan/reduce.rs:130 decision)."""
+    if not aggregates:
+        return ReducePlan("Distinct")
+    acc = tuple(
+        j for j, a in enumerate(aggregates) if a.func.is_accumulable
+    )
+    hier = tuple(
+        j for j, a in enumerate(aggregates) if a.func.is_hierarchical
+    )
+    unsupported = [
+        a.func
+        for a in aggregates
+        if not (a.func.is_accumulable or a.func.is_hierarchical)
+    ]
+    if unsupported:
+        raise NotImplementedError(f"aggregates {unsupported}")
+    if not hier:
+        return ReducePlan("Accumulable", acc, ())
+    if not acc:
+        # The accumulator part still runs (its __rows__ column is the
+        # group-liveness authority), so a pure-min/max reduce is still
+        # collated with the implicit count.
+        return ReducePlan("Collation", (), hier)
+    return ReducePlan("Collation", acc, hier)
+
+
+def join_implementation(expr: mir.Join) -> str:
+    """Resolve implementation='auto' (JoinImplementation analog): delta
+    for >=DELTA_JOIN_MIN_INPUTS inputs (no intermediate arrangements),
+    linear otherwise."""
+    impl = expr.implementation
+    if impl == "auto":
+        from ..utils.dyncfg import COMPUTE_CONFIGS, DELTA_JOIN_MIN_INPUTS
+
+        impl = (
+            "delta"
+            if len(expr.inputs) >= DELTA_JOIN_MIN_INPUTS(COMPUTE_CONFIGS)
+            else "linear"
+        )
+    return impl
+
+
+def join_stage_keys(expr: mir.Join, offsets: list, stage: int):
+    """Join keys for the linear-join stage bringing in input `stage`:
+    pairs (acc column, right column) from equivalence classes with a
+    member on each side. Analog of JoinImplementation's key selection
+    (transform/src/join_implementation.rs) restricted to column
+    equivalences."""
+    lo, hi = offsets[stage], offsets[stage + 1]
+    left_key, right_key = [], []
+    consumed = []
+    for ci, cls in enumerate(expr.equivalences):
+        cols = []
+        for e in cls:
+            if not isinstance(e, ColumnRef):
+                raise NotImplementedError(
+                    "join equivalences must be column references "
+                    "(pre-map complex exprs)"
+                )
+            cols.append(e.index)
+        lefts = [c for c in cols if c < lo]
+        rights = [c for c in cols if lo <= c < hi]
+        if lefts and rights:
+            left_key.append(lefts[0])
+            right_key.append(rights[0] - lo)
+            consumed.append(ci)
+            if len(lefts) > 1 or len(rights) > 1:
+                raise NotImplementedError(
+                    ">2-member equivalence classes need residual filters"
+                )
+    return tuple(left_key), tuple(right_key), consumed
+
+
+def plan_join(expr: mir.Join) -> JoinPlan:
+    impl = join_implementation(expr)
+    if impl == "delta":
+        from ..ops.delta_join import _plan_pipelines
+
+        arities = [i.schema().arity for i in expr.inputs]
+        pipelines, arr_specs = _plan_pipelines(
+            len(expr.inputs), arities, expr.equivalences
+        )
+        return JoinPlan(
+            "Delta",
+            n_pipelines=len(pipelines),
+            arrangements=tuple((j, tuple(k)) for j, k in arr_specs),
+        )
+    offsets = [0]
+    for i in expr.inputs:
+        offsets.append(offsets[-1] + i.schema().arity)
+    stages = []
+    for s in range(1, len(expr.inputs)):
+        lk, rk, _ = join_stage_keys(expr, offsets, s)
+        stages.append(LinearStagePlan(lk, rk))
+    return JoinPlan("Linear", stages=tuple(stages))
+
+
+def plan_topk(expr: mir.TopK, input_monotonic: bool) -> TopKPlan:
+    if input_monotonic and expr.limit == 1 and not expr.offset:
+        kind = "MonotonicTop1"
+    elif input_monotonic:
+        kind = "MonotonicTopK"
+    else:
+        kind = "Basic"
+    return TopKPlan(
+        kind, tuple(expr.group_key), expr.limit, expr.offset
+    )
+
+
+def plan_threshold(expr: mir.Threshold) -> ThresholdPlan:
+    return ThresholdPlan()
+
+
+# -- physical monotonicity (plan/interpret/physically_monotonic.rs) ----------
+
+
+def monotonic(expr: mir.RelationExpr, source_monotonic=frozenset()):
+    """Bottom-up: can this collection ever retract? Sources are
+    append-only iff named in `source_monotonic` (the controller knows;
+    e.g. load generators in insert-only mode)."""
+    if isinstance(expr, mir.Get):
+        return expr.name in source_monotonic
+    if isinstance(expr, mir.Constant):
+        return all(d >= 0 for _, d in expr.rows)
+    if isinstance(expr, (mir.Project, mir.Map, mir.Filter, mir.FlatMap,
+                         mir.ArrangeBy)):
+        return monotonic(expr.input, source_monotonic)
+    if isinstance(expr, mir.Join):
+        return all(monotonic(i, source_monotonic) for i in expr.inputs)
+    if isinstance(expr, mir.Union):
+        return all(monotonic(i, source_monotonic) for i in expr.inputs)
+    if isinstance(expr, (mir.Reduce, mir.TopK)):
+        # outputs retract when groups change, even over monotonic input
+        return False
+    if isinstance(expr, (mir.Negate, mir.Threshold)):
+        return False
+    if isinstance(expr, mir.Let):
+        # conservative: body monotonicity with the binding treated as
+        # non-monotonic unless its value is
+        if monotonic(expr.value, source_monotonic):
+            return monotonic(
+                expr.body, source_monotonic | {expr.name}
+            )
+        return monotonic(expr.body, source_monotonic)
+    if isinstance(expr, mir.LetRec):
+        return False
+    return False

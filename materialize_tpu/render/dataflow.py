@@ -67,8 +67,14 @@ class _RenderContext:
 
     def __init__(self, source_schemas: dict, num_shards: int = 1,
                  axis_name: str = WORKER_AXIS, slot_cap: int = 256,
-                 join_cap: int = 1024):
+                 join_cap: int = 1024, state_cap: int = 256):
         self.source_schemas = source_schemas
+        # Initial capacity tier for every stateful operator's
+        # arrangements. Overflow growth doubles tiers as needed; callers
+        # that know their steady-state size pass a larger tier up front
+        # to skip the overflow->grow->recompile ladder (each rung is a
+        # fresh XLA compile of the step program).
+        self.state_cap = state_cap
         self.slots: list[_StateSlot] = []
         self.operators: list = []  # parallel to slots: op configs
         self.num_shards = num_shards
@@ -248,7 +254,7 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
         op = TemporalFilterOp(
             expr.input.schema(), tuple(lo_exprs), tuple(hi_exprs)
         )
-        slot = ctx.new_slot(op, op.init_state())
+        slot = ctx.new_slot(op, op.init_state(ctx.state_cap))
         osite = ctx.new_join_site()  # output-capacity tier
 
         def run(states, inputs, time):
@@ -294,7 +300,7 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
         op = ReduceOp(
             expr.input.schema(), expr.group_key, expr.aggregates
         )
-        slot = ctx.new_slot(op, op.init_state())
+        slot = ctx.new_slot(op, op.init_state(ctx.state_cap))
         site = ctx.new_exchange_site()
         inner = _build(expr.input, ctx)
         group_key = expr.group_key
@@ -337,7 +343,7 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
 
     if isinstance(expr, mir.Threshold):
         op = ThresholdOp(expr.input.schema())
-        slot = ctx.new_slot(op, op.init_state())
+        slot = ctx.new_slot(op, op.init_state(ctx.state_cap))
         site = ctx.new_exchange_site()
         inner = _build(expr.input, ctx)
         all_cols = tuple(range(expr.input.schema().arity))
@@ -360,7 +366,7 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
             expr.input.schema(), expr.group_key, expr.order_by,
             expr.limit, expr.offset,
         )
-        slot = ctx.new_slot(op, op.init_state())
+        slot = ctx.new_slot(op, op.init_state(ctx.state_cap))
         site = ctx.new_exchange_site()
         inner = _build(expr.input, ctx)
         group_key = expr.group_key
@@ -405,50 +411,13 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
     )
 
 
-def _join_stage_keys(expr: mir.Join, offsets: list, stage: int):
-    """Join keys for the linear-join stage bringing in input `stage`:
-    pairs (acc column, right column) from equivalence classes with a
-    member on each side. Analog of JoinImplementation's key selection
-    (transform/src/join_implementation.rs) restricted to column
-    equivalences."""
-    from ..expr.scalar import ColumnRef
-
-    lo, hi = offsets[stage], offsets[stage + 1]
-    left_key, right_key = [], []
-    consumed = []
-    for ci, cls in enumerate(expr.equivalences):
-        cols = []
-        for e in cls:
-            if not isinstance(e, ColumnRef):
-                raise NotImplementedError(
-                    "join equivalences must be column references "
-                    "(pre-map complex exprs)"
-                )
-            cols.append(e.index)
-        lefts = [c for c in cols if c < lo]
-        rights = [c for c in cols if lo <= c < hi]
-        if lefts and rights:
-            left_key.append(lefts[0])
-            right_key.append(rights[0] - lo)
-            consumed.append(ci)
-            if len(lefts) > 1 or len(rights) > 1:
-                raise NotImplementedError(
-                    ">2-member equivalence classes need residual filters"
-                )
-    return tuple(left_key), tuple(right_key), consumed
-
-
 def _build_join(expr: mir.Join, ctx: _RenderContext):
-    from ..utils.dyncfg import COMPUTE_CONFIGS, DELTA_JOIN_MIN_INPUTS
+    # The linear-vs-delta decision and stage keys come from the plan
+    # layer (materialize_tpu/plan/decisions.py) so EXPLAIN PHYSICAL PLAN
+    # prints exactly what renders.
+    from ..plan import join_implementation
 
-    impl = expr.implementation
-    if impl == "auto":
-        impl = (
-            "delta"
-            if len(expr.inputs) >= DELTA_JOIN_MIN_INPUTS(COMPUTE_CONFIGS)
-            else "linear"
-        )
-    if impl == "delta":
+    if join_implementation(expr) == "delta":
         return _build_join_delta(expr, ctx)
     return _build_join_linear(expr, ctx)
 
@@ -460,7 +429,7 @@ def _build_join_delta(expr: mir.Join, ctx: _RenderContext):
     an all_to_all on the relevant key (the half_join exchange)."""
     schemas = [i.schema() for i in expr.inputs]
     op = DeltaJoinOp(tuple(schemas), expr.equivalences)
-    slot = ctx.new_slot(op, op.init_state())
+    slot = ctx.new_slot(op, op.init_state(ctx.state_cap))
     jsite = ctx.new_join_site()
     inners = [_build(i, ctx) for i in expr.inputs]
     ex_sites = {}
@@ -518,10 +487,12 @@ def _build_join_linear(expr: mir.Join, ctx: _RenderContext):
     acc_schema = schemas[0]
     all_consumed: set = set()
     for i in range(1, len(expr.inputs)):
-        left_key, right_key, consumed = _join_stage_keys(expr, offsets, i)
+        from ..plan import join_stage_keys
+
+        left_key, right_key, consumed = join_stage_keys(expr, offsets, i)
         all_consumed.update(consumed)
         op = JoinOp(acc_schema, schemas[i], left_key, right_key)
-        slot = ctx.new_slot(op, op.init_state())
+        slot = ctx.new_slot(op, op.init_state(ctx.state_cap))
         jsite = ctx.new_join_site()
         lsite = ctx.new_exchange_site()
         rsite = ctx.new_exchange_site()
@@ -739,6 +710,16 @@ class _DataflowBase:
         out_key = tuple(range(self.out_schema.arity))
         self.output = Arrangement.empty(self.out_schema, out_key, capacity)
         self._ovf_keys: list = []
+        # Device-resident logical time: created once, then carried as a
+        # step output -> next step input. Feeding time from the host
+        # would cost one h2d transfer per step — measured ~8 ms through
+        # the remote-TPU tunnel, which was the dominant per-step cost in
+        # round 1 (PERF_NOTES.md).
+        self._time_dev = None
+        # Deferred-overflow-check bookkeeping (see run_steps/check_flags).
+        self._defer_ck = None
+        self._defer_log: list = []
+        self._defer_flags: list = []
 
     def _pack_flags(self, ovf: dict) -> jnp.ndarray:
         """Deterministically order overflow flags into one tiny array.
@@ -788,60 +769,163 @@ class _DataflowBase:
         uniformly instead of duck-typing on the dataflow class."""
         return out
 
-    def run_steps(self, inputs_list: list) -> list:
-        """Feed several micro-batches with deferred overflow handling:
-        all steps are submitted asynchronously, the packed overflow flags
-        are read once at the end, and on overflow the whole span is
-        rolled back (states are immutable device values), tiers grown,
-        and the span replayed — steps are pure, so the replay is
-        idempotent. This keeps the hot loop free of per-step syncs."""
-        if getattr(self, "_first_time", None) is None:
-            # The dataflow's as_of: the first processed timestamp
-            # (constants fire exactly here; baked at trace time).
-            self._first_time = int(self.time)
-            self._ctx.first_time = self._first_time
-        packed = [self._pack_inputs(i) for i in inputs_list]
-        env = None
+    @property
+    def time(self) -> int:
+        """Host mirror of the dataflow frontier (all steps < time are
+        complete)."""
+        return self._time
+
+    @time.setter
+    def time(self, v: int) -> None:
+        # External time assignment (e.g. MaintainedView aligning the
+        # dataflow to a shard as_of) must invalidate the device-resident
+        # time carry, or steps would run at a stale timestamp. The hot
+        # loop (_dispatch_span) advances self._time directly so the
+        # carry survives normal stepping.
+        self._time = v
+        if getattr(self, "_time_dev", None) is not None:
+            self._time_dev = None
+
+    def _build_env(self):
         if getattr(self, "_str_keys", None):
             # dictionary side-tables for string functions: built once
             # per span (inputs are already encoded, so the dictionary
             # is stable across the span's steps)
             from ..expr import strings
 
-            env = strings.build_env(
+            return strings.build_env(
                 self._str_keys, getattr(self, "_str_depth", 1)
             )
-        while True:
-            ck = (list(self.states), self.output, self.time)
-            deltas, flags = [], []
-            for p in packed:
-                t = jnp.asarray(self.time, dtype=jnp.uint64)
-                args = (tuple(self.states), self.output, p, t)
-                if env is not None:
-                    out, new_states, new_output, fl = self._step_jit(
-                        *args, env
-                    )
-                else:
-                    out, new_states, new_output, fl = self._step_jit(
-                        *args
-                    )
-                self.states = list(new_states)
-                self.output = new_output
-                self.time += 1
-                deltas.append(out)
-                flags.append(fl)
-            if flags and self._ovf_keys:
-                fh = np.asarray(jnp.stack(flags))  # [K, nkeys] or [K, nkeys, P]
-                per_key = fh.reshape(fh.shape[0], len(self._ovf_keys), -1)
-                overflowed = per_key.any(axis=(0, 2))
+        return None
+
+    def _checkpoint(self):
+        return (list(self.states), self.output, self.time, self._time_dev)
+
+    def _restore(self, ck):
+        self.states, self.output, self.time, self._time_dev = ck
+
+    def _dispatch_span(self, packed: list, env) -> tuple[list, list]:
+        """Asynchronously dispatch one step per packed input. ZERO host
+        transfers: time rides as a device scalar (created once per
+        dataflow), overflow flags stay on device for the caller to
+        check. Returns (deltas, per-step flag arrays)."""
+        if self._time_dev is None:
+            self._time_dev = jnp.asarray(self.time, dtype=jnp.uint64)
+        deltas, flags = [], []
+        for p in packed:
+            args = (tuple(self.states), self.output, p, self._time_dev)
+            if env is not None:
+                out, new_states, new_output, new_t, fl = self._step_jit(
+                    *args, env
+                )
             else:
-                overflowed = np.zeros(0, dtype=bool)
+                out, new_states, new_output, new_t, fl = self._step_jit(
+                    *args
+                )
+            self.states = list(new_states)
+            self.output = new_output
+            self._time_dev = new_t
+            self._time += 1  # direct: keep the device carry live
+            deltas.append(out)
+            flags.append(fl)
+        return deltas, flags
+
+    def _read_flags(self, flags: list) -> np.ndarray:
+        """One d2h readback of the packed overflow flags for a span.
+        NOTE: through the remote-TPU tunnel, the FIRST d2h readback in a
+        process permanently switches dispatch from pipelined-async to
+        synchronous round-trips (~10 ms/dispatch; measured, see
+        PERF_NOTES.md). Latency-critical paths defer this via
+        run_steps(defer_check=True) + check_flags()."""
+        if flags and self._ovf_keys:
+            fh = np.asarray(jnp.stack(flags))  # [K, nkeys] or [K, nkeys, P]
+            per_key = fh.reshape(fh.shape[0], len(self._ovf_keys), -1)
+            return per_key.any(axis=(0, 2))
+        return np.zeros(0, dtype=bool)
+
+    def run_steps(self, inputs_list: list, defer_check: bool = False) -> list:
+        """Feed several micro-batches with deferred overflow handling:
+        all steps are submitted asynchronously and the packed overflow
+        flags are read once at the end of the span; on overflow the
+        whole span is rolled back (states are immutable device values),
+        tiers grown, and the span replayed — steps are pure, so the
+        replay is idempotent. This keeps the hot loop free of per-step
+        syncs.
+
+        With ``defer_check=True`` even the end-of-span readback is
+        skipped: flags are stashed on device and only read when the
+        caller invokes :meth:`check_flags` (or a later synchronous
+        ``run_steps``). Until then the span's inputs stay referenced so
+        an overflow discovered later can still roll back and replay.
+
+        CAVEAT: deltas returned from a deferred span are PROVISIONAL —
+        if a tier overflowed they were computed against truncated
+        arrangements. Do not feed them to a sink until
+        :meth:`check_flags` returns False; when it returns True, the
+        corrected per-step deltas of the replay are available on
+        ``self.replayed_deltas`` (in dispatch order)."""
+        if getattr(self, "_first_time", None) is None:
+            # The dataflow's as_of: the first processed timestamp
+            # (constants fire exactly here; baked at trace time).
+            self._first_time = int(self.time)
+            self._ctx.first_time = self._first_time
+        packed = [self._pack_inputs(i) for i in inputs_list]
+        env = self._build_env()
+        if defer_check:
+            if self._defer_ck is None:
+                self._defer_ck = self._checkpoint()
+            deltas, flags = self._dispatch_span(packed, env)
+            self._defer_log.append((packed, env))
+            self._defer_flags.extend(flags)
+            return deltas
+        self.check_flags()
+        while True:
+            ck = self._checkpoint()
+            deltas, flags = self._dispatch_span(packed, env)
+            overflowed = self._read_flags(flags)
             if overflowed.any():
-                self.states, self.output, self.time = ck
+                self._restore(ck)
                 for i in np.nonzero(overflowed)[0]:
                     self._grow_for(self._ovf_keys[i])
                 continue
             return deltas
+
+    def check_flags(self) -> bool:
+        """Resolve deferred overflow checks: one flags readback covering
+        every span dispatched with ``defer_check=True``. On overflow,
+        rolls back to the pre-defer checkpoint, grows the flagged tiers,
+        and replays the logged spans synchronously. Returns whether any
+        overflow occurred (callers timing the deferred spans use this to
+        invalidate their measurement)."""
+        if not self._defer_flags:
+            self._defer_ck = None
+            self._defer_log = []
+            return False
+        overflowed = self._read_flags(self._defer_flags)
+        log = self._defer_log
+        ck = self._defer_ck
+        self._defer_log, self._defer_flags, self._defer_ck = [], [], None
+        if not overflowed.any():
+            return False
+        self._restore(ck)
+        for i in np.nonzero(overflowed)[0]:
+            self._grow_for(self._ovf_keys[i])
+        # The deltas handed out during the deferred window were computed
+        # against truncated state; the replay's corrected deltas are
+        # published for callers that forward deltas to sinks.
+        self.replayed_deltas = []
+        for packed, env in log:
+            while True:
+                ck2 = self._checkpoint()
+                deltas, flags = self._dispatch_span(packed, env)
+                ovf = self._read_flags(flags)
+                if not ovf.any():
+                    self.replayed_deltas.extend(deltas)
+                    break
+                self._restore(ck2)
+                for i in np.nonzero(ovf)[0]:
+                    self._grow_for(self._ovf_keys[i])
+        return True
 
 
 class Dataflow(_DataflowBase):
@@ -852,14 +936,15 @@ class Dataflow(_DataflowBase):
     index export (compute-types/src/dataflows.rs:32).
     """
 
-    def __init__(self, expr: mir.RelationExpr, name: str = "df"):
+    def __init__(self, expr: mir.RelationExpr, name: str = "df",
+                 state_cap: int = 256):
         from ..expr import strings
 
         self.expr = expr
         self.name = name
         self.out_schema = expr.schema()
         self._str_keys, self._str_depth = strings.collect_keys(expr)
-        ctx = _RenderContext({})
+        ctx = _RenderContext({}, state_cap=state_cap)
         self._run = _build(expr, ctx)
         self._ctx = ctx
         self.states = [s.init for s in ctx.slots]
@@ -913,10 +998,19 @@ class Dataflow(_DataflowBase):
         ovf = dict(ovf)
         ovf[("outd",)] = shrink_ovf
         ovf[("out",)] = out_ovf
-        return out, tuple(new_states), new_output, self._pack_flags(ovf)
+        # time+1 rides back to the host loop as a device scalar so the
+        # next step needs no h2d transfer (see _dispatch_span).
+        return (
+            out,
+            tuple(new_states),
+            new_output,
+            time + jnp.asarray(1, dtype=time.dtype),
+            self._pack_flags(ovf),
+        )
 
     def peek(self) -> list[tuple]:
         """Read the full maintained result (SELECT * FROM mv)."""
+        self.check_flags()
         return self.output.batch.to_rows()
 
 
@@ -952,7 +1046,7 @@ class ShardedDataflow(_DataflowBase):
 
     def __init__(self, expr: mir.RelationExpr, mesh, name: str = "df",
                  slot_cap: int = 256, input_shard_cap: int = 1024,
-                 output_cap: int = 256):
+                 output_cap: int = 256, state_cap: int = 256):
         from ..expr import strings
 
         self.expr = expr
@@ -969,7 +1063,7 @@ class ShardedDataflow(_DataflowBase):
         self.out_schema = expr.schema()
         ctx = _RenderContext(
             {}, num_shards=self.num_shards, axis_name=self.axis_name,
-            slot_cap=slot_cap,
+            slot_cap=slot_cap, state_cap=state_cap,
         )
         self._run = _build(expr, ctx)
         self._ctx = ctx
@@ -1082,7 +1176,8 @@ class ShardedDataflow(_DataflowBase):
             out = out.replace(count=out.count.reshape((1,)))
             new_states = tuple(vec_counts(s) for s in new_states)
             (new_output,) = vec_counts((new_output,))
-            return out, new_states, new_output, flags
+            new_time = time + jnp.asarray(1, dtype=time.dtype)
+            return out, new_states, new_output, new_time, flags
 
         def per_worker(states, output, inputs, time, env=None):
             from ..expr import strings
@@ -1107,7 +1202,7 @@ class ShardedDataflow(_DataflowBase):
                     in_specs=(P(self.axis_name), P(self.axis_name),
                               P(self.axis_name), P(), P()),
                     out_specs=(P(self.axis_name), P(self.axis_name),
-                               P(self.axis_name),
+                               P(self.axis_name), P(),
                                P(None, self.axis_name)),
                     check_vma=False,
                 )(states, output, inputs, time, env)
@@ -1119,7 +1214,7 @@ class ShardedDataflow(_DataflowBase):
                     in_specs=(P(self.axis_name), P(self.axis_name),
                               P(self.axis_name), P()),
                     out_specs=(P(self.axis_name), P(self.axis_name),
-                               P(self.axis_name),
+                               P(self.axis_name), P(),
                                P(None, self.axis_name)),
                     check_vma=False,
                 )(states, output, inputs, time)
@@ -1196,6 +1291,7 @@ class ShardedDataflow(_DataflowBase):
         """Gather and combine every worker's output-arrangement shard.
         Different workers may hold the same row value (outputs stay where
         they were computed), so diffs are summed host-side."""
+        self.check_flags()
         rows = self._gather_batch(self.output.batch).to_rows()
         acc: dict = {}
         for r in rows:

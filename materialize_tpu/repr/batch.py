@@ -115,7 +115,7 @@ class Batch:
         dev_nulls = tuple(
             (pad(nl, np.bool_) if nl is not None else None) for nl in nulls
         )
-        return Batch(
+        b = Batch(
             cols=dev_cols,
             nulls=dev_nulls,
             time=pad(time, TIME_DTYPE),
@@ -123,6 +123,12 @@ class Batch:
             count=jnp.asarray(n, dtype=jnp.int32),
             schema=schema,
         )
+        # Host-known row count for staging/benchmark code: reading
+        # `count` back from the device is a d2h transfer, which through
+        # the remote-TPU tunnel permanently de-pipelines dispatch
+        # (PERF_NOTES.md). Not a pytree field; lost on tree transforms.
+        b._host_count = n
+        return b
 
     @staticmethod
     def empty(schema: Schema, capacity: int = 256) -> "Batch":

@@ -44,6 +44,22 @@ class HColumn(HirScalar):
 
 
 @dataclass(frozen=True)
+class HOuterColumn(HirScalar):
+    """A correlated reference to an enclosing query's relation.
+
+    ``level`` counts query nestings outward (1 = the immediately
+    enclosing query); ``index`` is the column position in that query's
+    relation; ``column`` carries the resolved type so typing needs no
+    outer-schema context. The analog of the reference HIR's leveled
+    ``ColumnRef {level, column}`` (sql/src/plan/hir.rs), removed by
+    decorrelation in lowering.py."""
+
+    level: int
+    index: int
+    column: "Column"
+
+
+@dataclass(frozen=True)
 class HLiteral(HirScalar):
     value: object  # python scalar; None = NULL
     ctype: ColumnType
@@ -286,11 +302,18 @@ class ScopeItem:
 
 @dataclass
 class Scope:
-    """Column-name resolution for one relation (scope.rs analog)."""
+    """Column-name resolution for one relation (scope.rs analog).
+
+    ``columns`` optionally carries the relation's Column types in
+    parallel with ``items`` — needed when this scope serves as an OUTER
+    scope for a correlated subquery (the resolved type rides on the
+    HOuterColumn node)."""
 
     items: list
+    columns: Optional[list] = None
 
-    def resolve(self, parts: tuple) -> int:
+    def maybe_resolve(self, parts: tuple) -> Optional[int]:
+        """Index for the name, None if unknown; ambiguity still raises."""
         if len(parts) == 1:
             hits = [
                 i for i, it in enumerate(self.items) if it.name == parts[0]
@@ -304,13 +327,22 @@ class Scope:
         else:
             raise PlanError(f"too many name parts: {'.'.join(parts)}")
         if not hits:
-            raise PlanError(f"unknown column {'.'.join(parts)!r}")
+            return None
         if len(hits) > 1:
             raise PlanError(f"ambiguous column {'.'.join(parts)!r}")
         return hits[0]
 
+    def resolve(self, parts: tuple) -> int:
+        idx = self.maybe_resolve(parts)
+        if idx is None:
+            raise PlanError(f"unknown column {'.'.join(parts)!r}")
+        return idx
+
     def concat(self, other: "Scope") -> "Scope":
-        return Scope(self.items + other.items)
+        cols = None
+        if self.columns is not None and other.columns is not None:
+            cols = self.columns + other.columns
+        return Scope(self.items + other.items, cols)
 
 
 # -- catalog interface -------------------------------------------------------
@@ -410,7 +442,38 @@ def _to_mir_shape(e: HirScalar):
         )
     if isinstance(e, (HExists, HScalarSubquery)):
         raise PlanError("subquery not lowered before typing")
+    if isinstance(e, HOuterColumn):
+        raise PlanError(
+            "correlated reference not decorrelated before MIR conversion"
+        )
     raise NotImplementedError(type(e).__name__)
+
+
+def _strip_outer_for_typing(e: HirScalar) -> HirScalar:
+    """Replace correlated references with typed NULL placeholders so the
+    expression can be typed against the inner schema alone (nullability
+    is pessimistic: an outer reference types as nullable)."""
+    if isinstance(e, HOuterColumn):
+        return HLiteral(None, e.column.ctype, e.column.scale)
+    if isinstance(e, HCallUnary):
+        return HCallUnary(e.func, _strip_outer_for_typing(e.expr))
+    if isinstance(e, HCallBinary):
+        return HCallBinary(
+            e.func,
+            _strip_outer_for_typing(e.left),
+            _strip_outer_for_typing(e.right),
+        )
+    if isinstance(e, HCallVariadic):
+        return HCallVariadic(
+            e.func, tuple(_strip_outer_for_typing(x) for x in e.exprs)
+        )
+    if isinstance(e, HIf):
+        return HIf(
+            _strip_outer_for_typing(e.cond),
+            _strip_outer_for_typing(e.then),
+            _strip_outer_for_typing(e.els),
+        )
+    return e
 
 
 def typ_of(e: HirScalar, schema: Schema) -> Column:
@@ -422,4 +485,119 @@ def typ_of(e: HirScalar, schema: Schema) -> Column:
         return Column(c.name, c.ctype, True, c.scale)
     if isinstance(e, HExists):
         return Column("exists", ColumnType.BOOL, False)
-    return _to_mir_shape(e).typ(schema)
+    if isinstance(e, HOuterColumn):
+        c = e.column
+        return Column(c.name, c.ctype, c.nullable, c.scale)
+    return _to_mir_shape(_strip_outer_for_typing(e)).typ(schema)
+
+
+# -- correlation analysis -----------------------------------------------------
+
+
+def scalar_subqueries(e: HirScalar):
+    """The subquery-bearing nodes directly inside a scalar."""
+    if isinstance(e, (HExists, HScalarSubquery, HInSubquery)):
+        yield e
+    elif isinstance(e, HCallUnary):
+        yield from scalar_subqueries(e.expr)
+    elif isinstance(e, HCallBinary):
+        yield from scalar_subqueries(e.left)
+        yield from scalar_subqueries(e.right)
+    elif isinstance(e, HCallVariadic):
+        for x in e.exprs:
+            yield from scalar_subqueries(x)
+    elif isinstance(e, HIf):
+        yield from scalar_subqueries(e.cond)
+        yield from scalar_subqueries(e.then)
+        yield from scalar_subqueries(e.els)
+    if isinstance(e, HInSubquery):
+        yield from scalar_subqueries(e.expr)
+
+
+def _relation_scalars(rel: HirRelation):
+    if isinstance(rel, HMap):
+        return [s for s, _ in rel.scalars]
+    if isinstance(rel, HFilter):
+        return list(rel.predicates)
+    if isinstance(rel, HJoin):
+        return list(rel.on)
+    if isinstance(rel, HReduce):
+        return [a.expr for a in rel.aggregates]
+    return []
+
+
+def _relation_children(rel: HirRelation):
+    if isinstance(rel, (HProject, HMap, HFilter, HReduce, HDistinct,
+                        HTopK, HNegate, HThreshold, HRename)):
+        return [rel.input]
+    if isinstance(rel, HJoin):
+        return [rel.left, rel.right]
+    if isinstance(rel, HUnion):
+        return list(rel.inputs)
+    if isinstance(rel, HLet):
+        return [rel.value, rel.body]
+    if isinstance(rel, HLetRec):
+        return list(rel.values) + [rel.body]
+    return []
+
+
+# Identity-keyed memo: HIR nodes are immutable (frozen dataclasses), and
+# decorrelation calls free_outer_refs/is_correlated at every _apply
+# recursion level — without memoization lowering is O(n^2) in subquery
+# size. The cache entry keeps a strong reference to the node so an id()
+# can never be reused while its entry is live.
+_FREE_CACHE: dict = {}
+
+
+def _scalar_free(e: HirScalar) -> frozenset:
+    """Free (level, index, Column) refs of one scalar, relative to the
+    relation it is evaluated over."""
+    if isinstance(e, HOuterColumn):
+        return frozenset({(e.level, e.index, e.column)})
+    if isinstance(e, (HExists, HScalarSubquery, HInSubquery)):
+        # Refs inside the subquery: level 1 refers to OUR relation (not
+        # free here), deeper levels shift down by one.
+        out = {
+            (lvl - 1, idx, col)
+            for lvl, idx, col in free_outer_refs(e.rel)
+            if lvl >= 2
+        }
+        if isinstance(e, HInSubquery):
+            out |= _scalar_free(e.expr)
+        return frozenset(out)
+    if isinstance(e, HCallUnary):
+        return _scalar_free(e.expr)
+    if isinstance(e, HCallBinary):
+        return _scalar_free(e.left) | _scalar_free(e.right)
+    if isinstance(e, HCallVariadic):
+        out: frozenset = frozenset()
+        for x in e.exprs:
+            out |= _scalar_free(x)
+        return out
+    if isinstance(e, HIf):
+        return (
+            _scalar_free(e.cond)
+            | _scalar_free(e.then)
+            | _scalar_free(e.els)
+        )
+    return frozenset()
+
+
+def free_outer_refs(rel: HirRelation) -> frozenset:
+    """(level, index, Column) triples of correlated references escaping
+    ``rel``, with level counted relative to rel's immediately enclosing
+    query (level 1 = that query's relation)."""
+    hit = _FREE_CACHE.get(id(rel))
+    if hit is not None and hit[0] is rel:
+        return hit[1]
+    out: frozenset = frozenset()
+    for s in _relation_scalars(rel):
+        out |= _scalar_free(s)
+    for c in _relation_children(rel):
+        out |= free_outer_refs(c)
+    _FREE_CACHE[id(rel)] = (rel, out)
+    return out
+
+
+def is_correlated(rel: HirRelation) -> bool:
+    return bool(free_outer_refs(rel))

@@ -377,6 +377,13 @@ def _explain(stmt: ast.Explain, catalog: CatalogInterface) -> Plan:
         from ..transform.optimizer import optimize
 
         m = optimize(m)
+    if stmt.stage == "physical":
+        # LIR: the operator-level physical plans (ReducePlan/TopKPlan/
+        # JoinPlan) actually chosen by the render layer — lowered by the
+        # shared decision functions (materialize_tpu/plan/decisions.py).
+        from ..plan import explain_lir, lower_mir
+
+        return ExplainPlan("physical", explain_lir(lower_mir(m)))
     return ExplainPlan(stmt.stage, explain_mir(m))
 
 

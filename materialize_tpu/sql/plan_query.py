@@ -158,6 +158,11 @@ class QueryPlanner:
     def __init__(self, catalog: CatalogInterface):
         self.catalog = catalog
         self._ctes: dict[str, Schema] = {}
+        # Stack of enclosing queries' scopes (innermost last): pushed
+        # around subquery planning so correlated names resolve to
+        # HOuterColumn(level, index) (the reference's leveled ColumnRef,
+        # sql/src/plan/scope.rs resolution order).
+        self._outer_scopes: list[Scope] = []
 
     # -- queries ---------------------------------------------------------
     def plan_query(self, q: ast.Query) -> tuple[HirRelation, Scope]:
@@ -303,7 +308,13 @@ class QueryPlanner:
                 if f.alias and f.alias.columns
                 else list(sch.names)
             )
-            scope = Scope([ScopeItem(alias, n) for n in names])
+            scope = Scope(
+                [ScopeItem(alias, n) for n in names],
+                [
+                    Column(n, c.ctype, c.nullable, c.scale)
+                    for n, c in zip(names, sch.columns)
+                ],
+            )
             return rel, scope
         if isinstance(f, ast.DerivedTable):
             rel, inner_scope = self.plan_query(f.query)
@@ -315,7 +326,13 @@ class QueryPlanner:
                 if f.alias.columns
                 else [it.name for it in inner_scope.items]
             )
-            scope = Scope([ScopeItem(f.alias.name, n) for n in names])
+            scope = Scope(
+                [ScopeItem(f.alias.name, n) for n in names],
+                [
+                    Column(n, c.ctype, c.nullable, c.scale)
+                    for n, c in zip(names, sch.columns)
+                ],
+            )
             return rel, scope
         raise NotImplementedError(type(f).__name__)
 
@@ -363,7 +380,7 @@ class QueryPlanner:
             rel, scope = self._plan_from(sel.from_)
         else:
             rel = HConstant(((tuple(), 1),), Schema([]))
-            scope = Scope([])
+            scope = Scope([], [])
 
         if sel.where is not None:
             rel = HFilter(rel, tuple(self._conjuncts(sel.where, scope)))
@@ -406,7 +423,10 @@ class QueryPlanner:
         if scalars:
             rel = HMap(rel, tuple(scalars))
         rel = HProject(rel, tuple(outputs))
-        out_scope = Scope([ScopeItem(None, n) for _, n in items])
+        out_scope = Scope(
+            [ScopeItem(None, n) for _, n in items],
+            list(rel.schema().columns),
+        )
         # Rename projected columns to their aliases.
         rel = _rebrand(rel, rel.schema().rename([n for _, n in items]))
         if sel.distinct:
@@ -629,6 +649,16 @@ class QueryPlanner:
         red = HReduce(rel, tuple(key_indices), tuple(aggs))
         if key_indices:
             return red
+        from .hir import is_correlated
+
+        # Check the REDUCE, not just its input: correlation can live in
+        # aggregate argument expressions alone.
+        if is_correlated(red):
+            # Correlated global aggregate: under decorrelation the
+            # reduce becomes per-outer-key and this one-row defaults
+            # union would be wrong; the branch lowering pads missing
+            # keys with per-aggregate defaults instead (lowering.py).
+            return red
         # Let-bind the reduce: it appears twice in the union (directly
         # and inside the nonempty flag) and must be computed ONCE (the
         # render layer shares Let bindings; without it the whole
@@ -665,10 +695,16 @@ class QueryPlanner:
 
     def _post_agg_scope(self, scope, key_indices, aggs):
         items = []
+        cols = []
         for i in key_indices:
             if i < len(scope.items):
                 items.append(
                     ScopeItem(scope.items[i].table, scope.items[i].name)
+                )
+                cols.append(
+                    scope.columns[i]
+                    if scope.columns is not None and i < len(scope.columns)
+                    else None
                 )
             else:
                 # GROUP BY <expression>: the key is a pre-mapped column
@@ -676,15 +712,34 @@ class QueryPlanner:
                 # '#' cannot appear in identifiers, so the name can
                 # never capture a real column reference.
                 items.append(ScopeItem(None, f"#gkey{i}"))
+                cols.append(None)
         items += [ScopeItem(None, a.out.name) for a in aggs]
-        return Scope(items)
+        cols += [a.out for a in aggs]
+        return Scope(items, cols if all(c is not None for c in cols) else None)
 
     # -- scalar expressions ----------------------------------------------
     def plan_expr(self, e: ast.Expr, scope: Scope):
         if isinstance(e, _PostAggColumn):
             return HColumn(e.index)
         if isinstance(e, ast.Ident):
-            return HColumn(scope.resolve(e.parts))
+            idx = scope.maybe_resolve(e.parts)
+            if idx is not None:
+                return HColumn(idx)
+            # Correlated reference: resolve against enclosing scopes,
+            # innermost first.
+            from .hir import HOuterColumn
+
+            for level, oscope in enumerate(
+                reversed(self._outer_scopes), start=1
+            ):
+                oidx = oscope.maybe_resolve(e.parts)
+                if oidx is not None:
+                    if oscope.columns is None:
+                        raise PlanError(
+                            "correlated reference into an untyped scope"
+                        )
+                    return HOuterColumn(level, oidx, oscope.columns[oidx])
+            raise PlanError(f"unknown column {'.'.join(e.parts)!r}")
         if isinstance(e, ast.NumberLit):
             return _number_literal(e.text)
         if isinstance(e, ast.StringLit):
@@ -841,16 +896,25 @@ class QueryPlanner:
                 )
             return self._plan_func(e, scope)
         if isinstance(e, ast.Exists):
-            rel, _ = self.plan_query(e.query)
+            rel, _ = self._plan_subquery(e.query, scope)
             return HExists(rel)
         if isinstance(e, ast.ScalarSubquery):
-            rel, _ = self.plan_query(e.query)
+            rel, _ = self._plan_subquery(e.query, scope)
             return HScalarSubquery(rel)
         if isinstance(e, ast.InSubquery):
-            rel, _ = self.plan_query(e.query)
+            rel, _ = self._plan_subquery(e.query, scope)
             x = self.plan_expr(e.expr, scope)
             return HInSubquery(x, rel, e.negated)
         raise NotImplementedError(type(e).__name__)
+
+    def _plan_subquery(self, q: ast.Query, scope: Scope):
+        """Plan a subquery with ``scope`` available as an outer scope for
+        correlated name resolution."""
+        self._outer_scopes.append(scope)
+        try:
+            return self.plan_query(q)
+        finally:
+            self._outer_scopes.pop()
 
     def _plan_cast(self, e: ast.Cast, scope: Scope):
         """CAST(expr AS type) — the typeconv analog (sql/src/plan/typeconv.rs).

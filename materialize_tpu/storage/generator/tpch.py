@@ -142,42 +142,72 @@ class TpchGenerator:
         )
 
     # -- per-order generation (deterministic in orderkey) -------------------
-    def _order_rng(self, orderkeys: np.ndarray) -> np.random.Generator:
-        # Deterministic per-batch: seeded from the key block.
-        return np.random.default_rng(
-            self.seed * 1_000_003 + int(orderkeys[0]) if len(orderkeys) else 0
-        )
+    #
+    # Counter-based (splitmix64-style) hashing instead of a sequential
+    # numpy Generator: every field of every row is a pure function of
+    # (seed, version, orderkey, linenumber, field tag). A sequential rng
+    # seeded per batch made a row's content depend on the BATCH it was
+    # generated in, so a churn tick's "delete the old rows" did not match
+    # the snapshot's rows — phantom +/- row pairs that cancel in sums but
+    # break EXISTS/DISTINCT/Threshold semantics (negative multiplicities
+    # are outside the differential contract; the reference's tpch.rs tick
+    # loop deletes exactly the rows it inserted).
+    def _mix64(self, *vals):
+        with np.errstate(over="ignore"):
+            h = np.uint64(0x9E3779B97F4A7C15)
+            for v in vals:
+                v = np.asarray(v, dtype=np.uint64)
+                z = (h ^ v) + np.uint64(0x9E3779B97F4A7C15)
+                z = (z ^ (z >> np.uint64(30))) * np.uint64(
+                    0xBF58476D1CE4E5B9
+                )
+                z = (z ^ (z >> np.uint64(27))) * np.uint64(
+                    0x94D049BB133111EB
+                )
+                h = z ^ (z >> np.uint64(31))
+        return h
 
-    def lineitems_for_orders(self, orderkeys: np.ndarray):
+    def _draw(self, lo: int, hi: int, *keys) -> np.ndarray:
+        """Uniform ints in [lo, hi), elementwise over broadcast keys."""
+        span = np.uint64(hi - lo)
+        return (self._mix64(*keys) % span).astype(np.int64) + lo
+
+    def lineitems_for_orders(
+        self, orderkeys: np.ndarray, version: int = 0
+    ):
         """Generate lineitem rows for the given order keys.
 
-        Returns (cols list, per-row orderkey index) matching
-        LINEITEM_SCHEMA order.
+        ``version`` selects the churn generation (0 = snapshot; churn
+        tick t writes version 1000+t): rows are deterministic in
+        (seed, version, orderkey) alone, never in batch composition.
         """
-        rng = self._order_rng(orderkeys)
-        n_lines = rng.integers(1, 8, size=len(orderkeys))  # avg 4, per spec
+        sd = np.uint64(self.seed * 1_000_003 + version)
+        ok_u = np.asarray(orderkeys, dtype=np.uint64)
+        n_lines = self._draw(1, 8, sd, ok_u, 11)  # 1..7, avg 4 per spec
         okeys = np.repeat(orderkeys, n_lines)
         n = len(okeys)
         linenumber = (
             np.arange(n) - np.repeat(np.cumsum(n_lines) - n_lines, n_lines)
         ).astype(np.int32) + 1
-        partkey = rng.integers(1, self.n_part + 1, size=n)
-        suppkey = rng.integers(1, self.n_supplier + 1, size=n)
-        quantity = rng.integers(1, 51, size=n) * 100  # 1..50, scale 2
+        u = okeys.astype(np.uint64)
+        li = linenumber.astype(np.uint64)
+        partkey = self._draw(1, self.n_part + 1, sd, u, li, 1)
+        suppkey = self._draw(1, self.n_supplier + 1, sd, u, li, 2)
+        quantity = self._draw(1, 51, sd, u, li, 3) * 100  # 1..50, scale 2
         retail = 90_000 + (partkey * 100) % 200_000 + (partkey % 1000) * 100
         extendedprice = (quantity // 100) * retail
-        discount = rng.integers(0, 11, size=n)  # 0.00..0.10
-        tax = rng.integers(0, 9, size=n)  # 0.00..0.08
+        discount = self._draw(0, 11, sd, u, li, 4)  # 0.00..0.10
+        tax = self._draw(0, 9, sd, u, li, 5)  # 0.00..0.08
         orderdate = _EPOCH_1992 + (
             (okeys * 2654435761) % (_DATE_RANGE - 151)
         ).astype(np.int64)
-        shipdate = orderdate + rng.integers(1, 122, size=n)
-        commitdate = orderdate + rng.integers(30, 91, size=n)
-        receiptdate = shipdate + rng.integers(1, 31, size=n)
+        shipdate = orderdate + self._draw(1, 122, sd, u, li, 6)
+        commitdate = orderdate + self._draw(30, 91, sd, u, li, 7)
+        receiptdate = shipdate + self._draw(1, 31, sd, u, li, 8)
         today = _EPOCH_1992 + _DATE_RANGE - 151
         returnflag = np.where(
             receiptdate <= today,
-            self._flag_codes[rng.integers(0, 2, size=n)],
+            self._flag_codes[self._draw(0, 2, sd, u, li, 9)],
             self._flag_codes[2],
         ).astype(np.int32)
         linestatus = np.where(
@@ -201,17 +231,19 @@ class TpchGenerator:
         return cols
 
     def orders_rows(self, orderkeys: np.ndarray):
-        rng = self._order_rng(orderkeys)
-        n = len(orderkeys)
-        custkey = rng.integers(1, self.n_customer + 1, size=n)
-        status = self._status_codes[rng.integers(0, 2, size=n)].astype(
-            np.int32
-        )
-        totalprice = rng.integers(1_000_00, 500_000_00, size=n)
+        sd = np.uint64(self.seed * 1_000_003)
+        u = np.asarray(orderkeys, dtype=np.uint64)
+        custkey = self._draw(1, self.n_customer + 1, sd, u, 21)
+        status = self._status_codes[
+            self._draw(0, 2, sd, u, 22)
+        ].astype(np.int32)
+        totalprice = self._draw(1_000_00, 500_000_00, sd, u, 23)
         orderdate = _EPOCH_1992 + (
             (orderkeys * 2654435761) % (_DATE_RANGE - 151)
         ).astype(np.int64)
-        prio = self._prio_codes[rng.integers(0, 5, size=n)].astype(np.int32)
+        prio = self._prio_codes[self._draw(0, 5, sd, u, 24)].astype(
+            np.int32
+        )
         return [
             orderkeys,
             custkey,
@@ -313,22 +345,32 @@ class TpchGenerator:
     ) -> Batch:
         """One tick of order churn: delete + regenerate `n_orders` orders'
         lineitems (the reference's tick loop deletes and re-inserts an
-        order per tick, tpch.rs)."""
+        order per tick, tpch.rs). The generator tracks each order's
+        current version so the deletion side matches EXACTLY the rows
+        previously inserted for it, even when ticks overlap on orders."""
         rng = np.random.default_rng(self.seed * 31 + tick)
         keys = np.sort(
             rng.choice(
                 np.arange(1, self.n_orders + 1), size=n_orders, replace=False
             )
         )
-        old = self.lineitems_for_orders(keys)
-        # regenerated with a different per-tick seed: mutate quantities etc.
-        self2 = TpchGenerator(self.sf, self.seed + 1000 + tick)
-        self2.n_part, self2.n_supplier, self2.n_customer = (
-            self.n_part,
-            self.n_supplier,
-            self.n_customer,
-        )
-        new = self2.lineitems_for_orders(keys)
+        if not hasattr(self, "_order_version"):
+            self._order_version: dict = {}
+        new_version = 1000 + tick
+        by_version: dict = {}
+        for k in keys:
+            v = self._order_version.get(int(k), 0)
+            by_version.setdefault(v, []).append(int(k))
+        old_parts = [
+            self.lineitems_for_orders(
+                np.asarray(sorted(ks), dtype=keys.dtype), version=v
+            )
+            for v, ks in sorted(by_version.items())
+        ]
+        old = [np.concatenate(cols) for cols in zip(*old_parts)]
+        new = self.lineitems_for_orders(keys, version=new_version)
+        for k in keys:
+            self._order_version[int(k)] = new_version
         cols = [np.concatenate([o, nw]) for o, nw in zip(old, new)]
         n_old, n_new = len(old[0]), len(new[0])
         diffs = np.concatenate(

@@ -197,8 +197,27 @@ def predicate_pushdown(expr: mir.RelationExpr) -> mir.RelationExpr:
             offsets = [0]
             for i in inp.inputs:
                 offsets.append(offsets[-1] + i.schema().arity)
+
+            def input_of(r: int) -> int:
+                for k in range(len(inp.inputs)):
+                    if offsets[k] <= r < offsets[k + 1]:
+                        return k
+                raise AssertionError(r)
+
+            def in_equivs(equivs, a: int, b: int) -> bool:
+                for cls in equivs:
+                    idxs = {
+                        c.index
+                        for c in cls
+                        if isinstance(c, ms.ColumnRef)
+                    }
+                    if a in idxs and b in idxs:
+                        return True
+                return False
+
             per_input: list = [[] for _ in inp.inputs]
             kept = []
+            new_equivs = list(inp.equivalences)
             for p in e.predicates:
                 refs: set = set()
                 _refs(p, refs)
@@ -218,15 +237,39 @@ def predicate_pushdown(expr: mir.RelationExpr) -> mir.RelationExpr:
                         kept.append(p)  # unpushable: keep at the join
                     else:
                         per_input[k].append(shifted)
-                else:
-                    kept.append(p)
-            if any(per_input):
+                    continue
+                # Cross-input column equality: lift into the join's
+                # equivalences so it becomes a JOIN KEY instead of a
+                # post-cross-product filter (the reference folds these
+                # during PredicatePushdown/equivalence extraction;
+                # decorrelation's keys⋈branch joins depend on it — a
+                # cross join of outer keys × subquery input explodes).
+                # SQL EQ and join-key equality agree: both drop NULLs.
+                if (
+                    isinstance(p, ms.CallBinary)
+                    and p.func is ms.BinaryFunc.EQ
+                    and isinstance(p.left, ms.ColumnRef)
+                    and isinstance(p.right, ms.ColumnRef)
+                    and input_of(p.left.index) != input_of(p.right.index)
+                ):
+                    a, b = sorted((p.left.index, p.right.index))
+                    if not in_equivs(new_equivs, a, b):
+                        new_equivs.append(
+                            (ms.ColumnRef(a), ms.ColumnRef(b))
+                        )
+                        continue
+                    # already implied: drop the predicate
+                    continue
+                kept.append(p)
+            if any(per_input) or len(new_equivs) != len(
+                inp.equivalences
+            ) or len(kept) != len(e.predicates):
                 new_inputs = tuple(
                     mir.Filter(i, tuple(ps)) if ps else i
                     for i, ps in zip(inp.inputs, per_input)
                 )
                 new = mir.Join(
-                    new_inputs, inp.equivalences, inp.implementation
+                    new_inputs, tuple(new_equivs), inp.implementation
                 )
                 return mir.Filter(new, tuple(kept)) if kept else new
         return e

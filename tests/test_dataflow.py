@@ -175,6 +175,44 @@ class TestTpchQ1:
         want = q1_oracle(all_rows, cutoff)
         assert got == want
 
+    def test_deferred_check_matches_sync_with_overflow(self):
+        """run_steps(defer_check=True) + check_flags() must converge to
+        the same maintained state as the synchronous path, including
+        when a capacity tier overflows mid-deferred-window (rollback to
+        the pre-defer checkpoint, grow, replay) and across the
+        device-resident time carry."""
+        gen = TpchGenerator(sf=0.001, seed=3)
+        batches = [
+            gen.churn_lineitem_batch(64, tick, time=tick)
+            for tick in range(8)
+        ]
+        # Per-order COUNT: distinct orders accumulate past the initial
+        # 256-row state tier, so the deferred window must roll back,
+        # grow, and replay.
+        group_count = mir.Get("lineitem", LINEITEM_SCHEMA).reduce(
+            (0,), (AggregateExpr(AggregateFunc.COUNT, lit(True)),)
+        )
+
+        df_sync = Dataflow(group_count)
+        for b in batches:
+            df_sync.step({"lineitem": b})
+        want = sorted(df_sync.peek())
+
+        df_def = Dataflow(group_count)
+        # Mixed deferred spans, flags only read at the end.
+        df_def.run_steps(
+            [{"lineitem": b} for b in batches[:2]], defer_check=True
+        )
+        df_def.run_steps(
+            [{"lineitem": b} for b in batches[2:]], defer_check=True
+        )
+        overflowed = df_def.check_flags()
+        assert overflowed  # the tiny tier must have tripped
+        assert sorted(df_def.peek()) == want
+        assert df_def.time == df_sync.time
+        # device time carry matches the host mirror after replay
+        assert int(np.asarray(df_def._time_dev)) == df_def.time
+
 
 class TestMinMaxReduce:
     def _dataflow(self):
