@@ -375,6 +375,26 @@ class ReplicaWorker:
             if as_of is not None and inst.view.upper <= as_of:
                 keep.append(p)  # not yet complete at as_of
                 continue
+            # ok/err pair: a nonempty err collection poisons reads until
+            # the offending rows are retracted (render.rs:12-101 — "SQL
+            # picks an arbitrary error if errs nonempty").
+            errs = inst.view.df.peek_errors()
+            if errs:
+                from ..expr.errors import MESSAGES
+
+                code = errs[0][0]
+                msg = MESSAGES.get(code, f"evaluation error {code}")
+                ctp.send_msg(
+                    conn,
+                    {
+                        "kind": "PeekResponse",
+                        "peek_id": p["peek_id"],
+                        "error": f"Evaluation error: {msg}",
+                        "replica_id": self.replica_id,
+                    },
+                )
+                served = True
+                continue
             rows = _result_rows(inst.view.result_batch())
             ctp.send_msg(
                 conn,

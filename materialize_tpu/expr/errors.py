@@ -1,0 +1,103 @@
+"""Error streams: the ok/err collection pair, TPU-cast.
+
+The reference renders every collection as parallel ok/err trees
+(compute/src/render.rs:12-101): a division by zero inside a maintained
+view produces an error VALUE in the err collection, surfaced as a SQL
+error on read, and retracts when the offending row is deleted.
+
+TPU re-cast: scalar evaluation sites (ops on data-dependent domains:
+division, casts) publish per-row error masks into a trace-scoped
+collector; the step function unions them into error update rows
+``(err_code, time, diff)`` maintained in a SECOND output arrangement next
+to the data output. Reads consult it first: nonempty => SQL error (the
+reference "picks an arbitrary error if errs nonempty"). Deleting the
+offending row feeds the same mask with diff=-1, retracting the error.
+
+Scope (documented): errors are detected inside MFP evaluation (Map /
+Filter / Project sites in render) — the places SQL expressions run over
+arbitrary data. Aggregate-internal expression errors are future work.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+# error codes (repr: EvalError analog, expr/src/scalar.rs EvalError)
+DIVISION_BY_ZERO = 1
+NUMERIC_OUT_OF_RANGE = 2
+
+MESSAGES = {
+    DIVISION_BY_ZERO: "division by zero",
+    NUMERIC_OUT_OF_RANGE: "numeric field overflow",
+}
+
+
+_tls = threading.local()
+
+
+def _sinks() -> list:
+    if not hasattr(_tls, "sinks"):
+        _tls.sinks = []
+    return _tls.sinks
+
+
+@contextlib.contextmanager
+def collect():
+    """Activate an error sink for the dynamic extent (trace time): eval
+    sites inside publish (code, mask) pairs via :func:`emit`. Yields the
+    sink list of (code, mask) tuples."""
+    sink: list = []
+    _sinks().append(sink)
+    try:
+        yield sink
+    finally:
+        _sinks().pop()
+
+
+def emit(code: int, mask) -> None:
+    """Publish a per-row error mask (True where the row's evaluation
+    errored). No-op when no sink is active — evaluation outside a
+    collecting step (tests, oracles) keeps the historical
+    NULL-on-error behavior."""
+    s = _sinks()
+    if s:
+        s[-1].append((code, jnp.asarray(mask)))
+
+
+def active() -> bool:
+    return bool(_sinks())
+
+
+# -- step-level error-batch sink ---------------------------------------------
+# apply_mfp converts (code, mask) pairs into error update batches and
+# pushes them here; the step function unions + consolidates them into
+# the dataflow's error output arrangement.
+
+
+def _step_sinks() -> list:
+    if not hasattr(_tls, "step_sinks"):
+        _tls.step_sinks = []
+    return _tls.step_sinks
+
+
+@contextlib.contextmanager
+def step_scope():
+    sink: list = []
+    _step_sinks().append(sink)
+    try:
+        yield sink
+    finally:
+        _step_sinks().pop()
+
+
+def push_step(err_batch) -> None:
+    s = _step_sinks()
+    if s:
+        s[-1].append(err_batch)
+
+
+def step_active() -> bool:
+    return bool(_step_sinks())

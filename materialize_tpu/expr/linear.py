@@ -78,12 +78,46 @@ class MapFilterProject:
 
 def apply_mfp(mfp: MapFilterProject, batch: Batch, time=None) -> Batch:
     """Evaluate the MFP over a batch: fused map+filter+project, compacted.
-    ``time`` is the step timestamp for mz_now() (non-temporal uses)."""
+    ``time`` is the step timestamp for mz_now() (non-temporal uses).
+
+    Scalar evaluation errors (division by zero, cast overflow) are
+    published as error update rows to the active error sink (the step's
+    err collection — expr/errors.py, the render.rs ok/err analog); with
+    no sink active, erroring rows keep the historical NULL result."""
     assert batch.schema.arity == mfp.input_arity, (
         f"mfp arity {mfp.input_arity} != batch arity {batch.schema.arity}"
     )
     if mfp.is_identity:
         return batch
+    from . import errors as _errors
+
+    with _errors.collect() as masks:
+        out = _apply_mfp_inner(mfp, batch, time)
+    if masks and _errors.step_active():
+        valid = batch.valid_mask()
+        for code, mask in masks:
+            _errors.push_step(
+                _err_batch(code, jnp.logical_and(mask, valid), batch)
+            )
+    return out
+
+
+def _err_batch(code: int, mask, batch: Batch) -> Batch:
+    """Error update rows: (err_code, time, diff) for masked rows."""
+    from ..repr.schema import ERR_SCHEMA
+
+    cap = batch.capacity
+    return Batch(
+        cols=(jnp.full(cap, code, dtype=jnp.int64),),
+        nulls=(None,),
+        time=batch.time,
+        diff=jnp.where(mask, batch.diff, 0),
+        count=batch.count,
+        schema=ERR_SCHEMA,
+    )
+
+
+def _apply_mfp_inner(mfp: MapFilterProject, batch: Batch, time=None) -> Batch:
 
     # Working set: input columns + mapped columns, with growing schema.
     work_cols = list(batch.cols)

@@ -532,6 +532,22 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
         if f == UnaryFunc.CAST_INT64:
             if e.col.ctype is ColumnType.DECIMAL:
                 v = e.values // (10**e.col.scale)
+            elif e.col.ctype is ColumnType.FLOAT64:
+                from . import errors as _err
+
+                x = e.values
+                # asymmetric bounds: -2^63 is exactly representable
+                bad = (
+                    jnp.isnan(x)
+                    | (x >= float(2**63))
+                    | (x < -float(2**63))
+                )
+                _err.emit(
+                    _err.NUMERIC_OUT_OF_RANGE,
+                    jnp.logical_and(bad, jnp.logical_not(e.null_mask())),
+                )
+                v = jnp.where(bad, 0.0, x).astype(jnp.int64)
+                return Evaled(v, _or_nulls(e.nulls, bad), col)
             else:
                 v = e.values.astype(jnp.int64)
             return Evaled(v, e.nulls, col)
@@ -545,7 +561,28 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
             if e.col.ctype is ColumnType.DECIMAL:
                 v = (e.values // (10**e.col.scale)).astype(jnp.int32)
             else:
-                v = e.values.astype(jnp.int32)
+                from . import errors as _err
+
+                x = e.values
+                if e.col.ctype is ColumnType.FLOAT64:
+                    bad = (
+                        jnp.isnan(x)
+                        | (x >= float(2**31))
+                        | (x < -float(2**31))
+                    )
+                    x = jnp.where(bad, 0.0, x)
+                else:
+                    xi = x.astype(jnp.int64)
+                    bad = jnp.logical_or(
+                        xi >= 2**31, xi < -(2**31)
+                    )
+                _err.emit(
+                    _err.NUMERIC_OUT_OF_RANGE,
+                    jnp.logical_and(bad, jnp.logical_not(e.null_mask())),
+                )
+                v = x.astype(jnp.int32)
+                v = jnp.where(bad, 0, v)
+                return Evaled(v, _or_nulls(e.nulls, bad), col)
             return Evaled(v, e.nulls, col)
         if f == UnaryFunc.CAST_BOOL:
             return Evaled(e.values != 0, e.nulls, col)
@@ -662,8 +699,22 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
             if f == BinaryFunc.SUB:
                 return Evaled(lv - rv, nulls, col)
             if f == BinaryFunc.DIV:
-                # decimal / decimal at left scale; NULL on zero divisor
+                # decimal / decimal at left scale; the zero-divisor rows
+                # become NULL here and surface through the error stream
+                # (render.rs ok/err trees) when a collector is active
                 zero = rv == 0
+                from . import errors as _err
+
+                _err.emit(
+                    _err.DIVISION_BY_ZERO,
+                    # pg: NULL numerator or divisor yields NULL, no error
+                    jnp.logical_and(
+                        zero,
+                        jnp.logical_not(
+                            jnp.logical_or(r.null_mask(), l.null_mask())
+                        ),
+                    ),
+                )
                 safe = jnp.where(zero, 1, rv)
                 v = (lv * (10**r.col.scale)) // safe
                 nulls = _or_nulls(nulls, zero)
@@ -675,13 +726,37 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
         if f == BinaryFunc.MUL:
             return Evaled(l.values * r.values, nulls, col)
         if f == BinaryFunc.DIV:
+            from . import errors as _err
+
             lv = _as_float(l)
             rv = _as_float(r)
             zero = rv == 0.0
+            _err.emit(
+                _err.DIVISION_BY_ZERO,
+                # pg: NULL numerator or divisor yields NULL, no error
+                jnp.logical_and(
+                    zero,
+                    jnp.logical_not(
+                        jnp.logical_or(r.null_mask(), l.null_mask())
+                    ),
+                ),
+            )
             v = lv / jnp.where(zero, 1.0, rv)
             return Evaled(v, _or_nulls(nulls, zero), col)
         if f == BinaryFunc.MOD:
+            from . import errors as _err
+
             zero = r.values == 0
+            _err.emit(
+                _err.DIVISION_BY_ZERO,
+                # pg: NULL numerator or divisor yields NULL, no error
+                jnp.logical_and(
+                    zero,
+                    jnp.logical_not(
+                        jnp.logical_or(r.null_mask(), l.null_mask())
+                    ),
+                ),
+            )
             v = jnp.where(zero, 0, l.values % jnp.where(zero, 1, r.values))
             return Evaled(v, _or_nulls(nulls, zero), col)
         if f == BinaryFunc.POWER:
@@ -745,6 +820,29 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
             table = strings.trace_env()[key]
             vals = table[jnp.clip(e.values, 0, table.shape[0] - 1)]
             return Evaled(vals, e.nulls, col)
+        if expr.func == VariadicFunc.COALESCE:
+            # pg evaluates COALESCE arguments in order until the first
+            # non-NULL: an argument's evaluation errors only count for
+            # rows that actually REACH it (all earlier args NULL).
+            from . import errors as _err
+
+            evaled, masksets = [], []
+            for x in expr.exprs:
+                with _err.collect() as m:
+                    evaled.append(eval_expr(x, batch, time))
+                masksets.append(m)
+            reached = jnp.ones(cap, dtype=bool)
+            for p, ms_ in zip(evaled, masksets):
+                for code, mask in ms_:
+                    _err.emit(code, jnp.logical_and(mask, reached))
+                reached = jnp.logical_and(reached, p.null_mask())
+            out_v = evaled[-1].values
+            out_n = evaled[-1].null_mask()
+            for p in reversed(evaled[:-1]):
+                take = jnp.logical_not(p.null_mask())
+                out_v = jnp.where(take, p.values, out_v)
+                out_n = jnp.where(take, jnp.zeros_like(out_n), out_n)
+            return Evaled(out_v, out_n, col)
         parts = [eval_expr(e, batch, time) for e in expr.exprs]
         if expr.func == VariadicFunc.AND:
             # SQL 3VL: FALSE dominates NULL
@@ -778,14 +876,6 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
                 any_null = jnp.logical_or(any_null, p.null_mask())
             nulls = jnp.logical_and(any_null, jnp.logical_not(known_true))
             return Evaled(val, nulls, col)
-        if expr.func == VariadicFunc.COALESCE:
-            out_v = parts[-1].values
-            out_n = parts[-1].null_mask()
-            for p in reversed(parts[:-1]):
-                take = jnp.logical_not(p.null_mask())
-                out_v = jnp.where(take, p.values, out_v)
-                out_n = jnp.where(take, jnp.zeros_like(out_n), out_n)
-            return Evaled(out_v, out_n, col)
         if expr.func == VariadicFunc.ADD_INTERVAL:
             e = parts[0]
             months, days, ms = (
@@ -839,11 +929,28 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
         raise NotImplementedError(expr.func)
 
     if isinstance(expr, If):
+        from . import errors as _err
+
         c = eval_expr(expr.cond, batch, time)
-        t = eval_expr(expr.then, batch, time)
-        e = eval_expr(expr.els, batch, time)
+        # CASE/If is SQL's error guard: both branches evaluate
+        # vectorized, but a branch's evaluation errors only count for
+        # rows that actually SELECT that branch (the reference's MfpPlan
+        # evaluates per-row lazily; here the masks are filtered).
+        cond_sel = jnp.logical_and(
+            c.values, jnp.logical_not(c.null_mask())
+        )
+        with _err.collect() as t_masks:
+            t = eval_expr(expr.then, batch, time)
+        with _err.collect() as e_masks:
+            e = eval_expr(expr.els, batch, time)
+        for code, m in t_masks:
+            _err.emit(code, jnp.logical_and(m, cond_sel))
+        for code, m in e_masks:
+            _err.emit(
+                code, jnp.logical_and(m, jnp.logical_not(cond_sel))
+            )
         col = expr.typ(schema)
-        cond = jnp.logical_and(c.values, jnp.logical_not(c.null_mask()))
+        cond = cond_sel
         tv, ev = t.values, e.values
         # branches of different device dtypes (an untyped NULL literal):
         # the principal branch (If.typ) defines the type; the NULL

@@ -707,8 +707,15 @@ class _DataflowBase:
     latency cost center, so the hot loop never reads data back)."""
 
     def _init_output(self, capacity: int = 256):
+        from ..repr.schema import ERR_SCHEMA
+
         out_key = tuple(range(self.out_schema.arity))
         self.output = Arrangement.empty(self.out_schema, out_key, capacity)
+        # The err collection: scalar-evaluation errors maintained next
+        # to the data output (ok/err pair, render.rs:12-101). Reads
+        # consult it first; deleting the offending row retracts the
+        # error.
+        self.err_output = Arrangement.empty(ERR_SCHEMA, (0,), 256)
         self._ovf_keys: list = []
         # Device-resident logical time: created once, then carried as a
         # step output -> next step input. Feeding time from the host
@@ -754,6 +761,8 @@ class _DataflowBase:
         elif key[0] == "outd":
             self._ctx.out_delta_cap *= 2
             self._remake_jit()
+        elif key[0] == "errout":
+            self.err_output = self._grow_arrangement(self.err_output)
         else:
             raise AssertionError(f"unknown overflow key {key}")
 
@@ -786,6 +795,31 @@ class _DataflowBase:
         if getattr(self, "_time_dev", None) is not None:
             self._time_dev = None
 
+    def _apply_err_delta(self, err_output, err_parts, ovf: dict):
+        """Fold a step's collected error batches into the err
+        arrangement (shared by single-device and sharded step bodies).
+        Returns the new err arrangement; mutates ovf and records the
+        trace-time fact of whether this dataflow CAN produce errors
+        (peek_errors shortcuts when it can't)."""
+        self._has_errors = bool(err_parts)
+        if not err_parts:
+            return err_output
+        errs = consolidate(
+            concat_batches(err_parts), include_time=False
+        )
+        errs, err_shrink = shrink(errs, 2048)
+        new_err, err_ovf = insert(
+            err_output, errs, out_capacity=err_output.capacity
+        )
+        ovf[("errout",)] = jnp.logical_or(err_shrink, err_ovf)
+        return new_err
+
+    def _accumulate_errors(self, rows) -> list[tuple]:
+        acc: dict = {}
+        for r in rows:
+            acc[r[0]] = acc.get(r[0], 0) + r[-1]
+        return sorted((c, n) for c, n in acc.items() if n != 0)
+
     def _build_env(self):
         if getattr(self, "_str_keys", None):
             # dictionary side-tables for string functions: built once
@@ -799,10 +833,22 @@ class _DataflowBase:
         return None
 
     def _checkpoint(self):
-        return (list(self.states), self.output, self.time, self._time_dev)
+        return (
+            list(self.states),
+            self.output,
+            self.err_output,
+            self.time,
+            self._time_dev,
+        )
 
     def _restore(self, ck):
-        self.states, self.output, self.time, self._time_dev = ck
+        (
+            self.states,
+            self.output,
+            self.err_output,
+            self.time,
+            self._time_dev,
+        ) = ck
 
     def _dispatch_span(self, packed: list, env) -> tuple[list, list]:
         """Asynchronously dispatch one step per packed input. ZERO host
@@ -813,17 +859,24 @@ class _DataflowBase:
             self._time_dev = jnp.asarray(self.time, dtype=jnp.uint64)
         deltas, flags = [], []
         for p in packed:
-            args = (tuple(self.states), self.output, p, self._time_dev)
+            args = (
+                tuple(self.states),
+                self.output,
+                self.err_output,
+                p,
+                self._time_dev,
+            )
             if env is not None:
-                out, new_states, new_output, new_t, fl = self._step_jit(
-                    *args, env
+                out, new_states, new_output, new_err, new_t, fl = (
+                    self._step_jit(*args, env)
                 )
             else:
-                out, new_states, new_output, new_t, fl = self._step_jit(
-                    *args
+                out, new_states, new_output, new_err, new_t, fl = (
+                    self._step_jit(*args)
                 )
             self.states = list(new_states)
             self.output = new_output
+            self.err_output = new_err
             self._time_dev = new_t
             self._time += 1  # direct: keep the device carry live
             deltas.append(out)
@@ -961,11 +1014,13 @@ class Dataflow(_DataflowBase):
         # entries).
         if self._str_keys:
             self._step_jit = jax.jit(
-                lambda s, o, i, t, env: self._step_core(s, o, i, t, env)
+                lambda s, o, eo, i, t, env: self._step_core(
+                    s, o, eo, i, t, env
+                )
             )
         else:
             self._step_jit = jax.jit(
-                lambda s, o, i, t: self._step_core(s, o, i, t)
+                lambda s, o, eo, i, t: self._step_core(s, o, eo, i, t)
             )
 
     def _grow_arrangement(self, arr: Arrangement) -> Arrangement:
@@ -977,14 +1032,20 @@ class Dataflow(_DataflowBase):
         return inputs
 
     # pure, jitted once per capacity signature
-    def _step_core(self, states, output, inputs, time, env=None):
+    def _step_core(self, states, output, err_output, inputs, time,
+                   env=None):
         from ..expr import strings
 
         with strings.trace_scope(env if env is not None else {}):
-            return self._step_core_inner(states, output, inputs, time)
+            return self._step_core_inner(
+                states, output, err_output, inputs, time
+            )
 
-    def _step_core_inner(self, states, output, inputs, time):
-        out, upd, ovf = self._run(states, inputs, time)
+    def _step_core_inner(self, states, output, err_output, inputs, time):
+        from ..expr import errors as _errors
+
+        with _errors.step_scope() as err_parts:
+            out, upd, ovf = self._run(states, inputs, time)
         new_states = list(states)
         for k, v in upd.items():
             new_states[k] = v
@@ -998,12 +1059,16 @@ class Dataflow(_DataflowBase):
         ovf = dict(ovf)
         ovf[("outd",)] = shrink_ovf
         ovf[("out",)] = out_ovf
+        # The err collection delta (scalar-eval errors published by
+        # apply_mfp sites during the _run trace above).
+        new_err = self._apply_err_delta(err_output, err_parts, ovf)
         # time+1 rides back to the host loop as a device scalar so the
         # next step needs no h2d transfer (see _dispatch_span).
         return (
             out,
             tuple(new_states),
             new_output,
+            new_err,
             time + jnp.asarray(1, dtype=time.dtype),
             self._pack_flags(ovf),
         )
@@ -1012,6 +1077,17 @@ class Dataflow(_DataflowBase):
         """Read the full maintained result (SELECT * FROM mv)."""
         self.check_flags()
         return self.output.batch.to_rows()
+
+    def peek_errors(self) -> list[tuple]:
+        """The maintained err collection: [(err_code, count)] with
+        count != 0. Nonempty means reads of this dataflow must raise
+        (the reference picks an arbitrary error; render.rs:12-101).
+        Dataflows whose step program has no error-emitting sites (a
+        trace-time fact) skip the device readback entirely."""
+        if not getattr(self, "_has_errors", False):
+            return []
+        self.check_flags()
+        return self._accumulate_errors(self.err_output.batch.to_rows())
 
 
 def _shard_rows(arrays, n: int, num_shards: int, shard_cap: int):
@@ -1075,6 +1151,7 @@ class ShardedDataflow(_DataflowBase):
         ]
         self._init_output(output_cap)
         self.output = self._replicate_empty_one(self.output)
+        self.err_output = self._replicate_empty_one(self.err_output)
         self.time = 0
         self._remake_jit()
 
@@ -1154,8 +1231,11 @@ class ShardedDataflow(_DataflowBase):
                 for a in s
             )
 
-        def body(states, output, inputs, time):
-            out, upd, ovf = self._run(states, inputs, time)
+        def body(states, output, err_output, inputs, time):
+            from ..expr import errors as _errors
+
+            with _errors.step_scope() as err_parts:
+                out, upd, ovf = self._run(states, inputs, time)
             new_states = list(states)
             for k, v in upd.items():
                 new_states[k] = v
@@ -1167,6 +1247,9 @@ class ShardedDataflow(_DataflowBase):
             ovf = dict(ovf)
             ovf[("outd",)] = shrink_ovf
             ovf[("out",)] = out_ovf
+            # Each worker maintains its own err shard (errors stay
+            # where computed; peek_errors gathers).
+            new_err = self._apply_err_delta(err_output, err_parts, ovf)
             # Overflow anywhere aborts the span on every worker.
             flags = self._pack_flags(ovf)
             flags = (
@@ -1176,48 +1259,52 @@ class ShardedDataflow(_DataflowBase):
             out = out.replace(count=out.count.reshape((1,)))
             new_states = tuple(vec_counts(s) for s in new_states)
             (new_output,) = vec_counts((new_output,))
+            (new_err,) = vec_counts((new_err,))
             new_time = time + jnp.asarray(1, dtype=time.dtype)
-            return out, new_states, new_output, new_time, flags
+            return out, new_states, new_output, new_err, new_time, flags
 
-        def per_worker(states, output, inputs, time, env=None):
+        def per_worker(states, output, err_output, inputs, time, env=None):
             from ..expr import strings
 
             # Leaves arrive rank-preserved: counts are [1]; make scalar.
             states = [scalar_counts(s) for s in states]
             (output,) = scalar_counts((output,))
+            (err_output,) = scalar_counts((err_output,))
             inputs = {
                 k: b.replace(count=b.count.reshape(()))
                 for k, b in inputs.items()
             }
             with strings.trace_scope(env if env is not None else {}):
-                return body(states, output, inputs, time)
+                return body(states, output, err_output, inputs, time)
 
         if self._str_keys:
             # env (the string side-tables) rides along REPLICATED: every
             # worker gathers through identical dictionaries
-            def step(states, output, inputs, time, env):
+            def step(states, output, err_output, inputs, time, env):
                 return jax.shard_map(
                     per_worker,
                     mesh=self.mesh,
                     in_specs=(P(self.axis_name), P(self.axis_name),
-                              P(self.axis_name), P(), P()),
+                              P(self.axis_name), P(self.axis_name),
+                              P(), P()),
                     out_specs=(P(self.axis_name), P(self.axis_name),
-                               P(self.axis_name), P(),
-                               P(None, self.axis_name)),
+                               P(self.axis_name), P(self.axis_name),
+                               P(), P(None, self.axis_name)),
                     check_vma=False,
-                )(states, output, inputs, time, env)
+                )(states, output, err_output, inputs, time, env)
         else:
-            def step(states, output, inputs, time):
+            def step(states, output, err_output, inputs, time):
                 return jax.shard_map(
-                    lambda s, o, i, t: per_worker(s, o, i, t),
+                    lambda s, o, eo, i, t: per_worker(s, o, eo, i, t),
                     mesh=self.mesh,
                     in_specs=(P(self.axis_name), P(self.axis_name),
-                              P(self.axis_name), P()),
+                              P(self.axis_name), P(self.axis_name),
+                              P()),
                     out_specs=(P(self.axis_name), P(self.axis_name),
-                               P(self.axis_name), P(),
-                               P(None, self.axis_name)),
+                               P(self.axis_name), P(self.axis_name),
+                               P(), P(None, self.axis_name)),
                     check_vma=False,
-                )(states, output, inputs, time)
+                )(states, output, err_output, inputs, time)
 
         self._step_jit = jax.jit(step)
 
@@ -1286,6 +1373,15 @@ class ShardedDataflow(_DataflowBase):
     def gather_delta(self, out: Batch) -> Batch:
         """Host view of a per-worker output delta from step()."""
         return self._gather_batch(out)
+
+    def peek_errors(self) -> list[tuple]:
+        """Gather every worker's err shard: [(err_code, count)]."""
+        if not getattr(self, "_has_errors", False):
+            return []
+        self.check_flags()
+        return self._accumulate_errors(
+            self._gather_batch(self.err_output.batch).to_rows()
+        )
 
     def peek(self) -> list[tuple]:
         """Gather and combine every worker's output-arrangement shard.

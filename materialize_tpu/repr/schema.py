@@ -236,3 +236,10 @@ def parse_text_value(raw: str, col: Column):
         raise ValueError(
             f"invalid {t.value} value {raw!r} for column {col.name!r}"
         ) from exc
+
+
+# The error-stream schema (one column: the error code; expr/errors.py
+# maps codes to messages). Every dataflow maintains an arrangement of
+# this shape next to its data output — the ok/err collection pair
+# (compute/src/render.rs:12-101).
+ERR_SCHEMA = Schema([Column("err_code", ColumnType.INT64)])

@@ -581,7 +581,19 @@ class QueryPlanner:
                                 "variance")
                     else c
                 )
-                var = ast.BinaryOp("/", num, denom)
+                # n<2 (or empty-group) denominators are NULL, not a
+                # division-by-zero error: CASE is SQL's error guard and
+                # the eval layer suppresses unselected-branch errors
+                var = ast.Case(
+                    None,
+                    (
+                        (
+                            ast.BinaryOp("=", denom, ast.NumberLit("0")),
+                            ast.NullLit(),
+                        ),
+                    ),
+                    ast.BinaryOp("/", num, denom),
+                )
                 if kind in ("stddev", "stddev_samp", "stddev_pop"):
                     var = ast.FuncCall("sqrt", (var,))
                 # all-NULL groups: sum is NULL and must stay NULL (the
