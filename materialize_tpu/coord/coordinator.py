@@ -108,6 +108,9 @@ class Coordinator:
         # dataflow name -> upstream SOURCE shards (timestamp selection
         # reads at the sources' time, then waits for the dataflow).
         self._df_upstream: dict[str, list] = {}
+        # dataflow name -> publisher dataflows whose arrangements it
+        # index-imports (drop protection for TraceManager sharing).
+        self._index_importers: dict[str, set] = {}
         # durable catalog bookkeeping
         self._cat_writer = self.persist.open_writer(
             CATALOG_SHARD, CATALOG_SCHEMA
@@ -658,13 +661,13 @@ class Coordinator:
         ``unlocked`` releases the sequencing lock during the wait —
         safe for SELECT, NOT for DML whose read must be atomic with its
         write."""
-        imports = self._source_imports(expr)
+        imports, index_imports = self._source_imports(expr)
         self._transient_seq += 1
         name = f"t{self._transient_seq}"
         self._register_dataflow(
             DataflowDescription(
                 name=name, expr=expr, source_imports=imports,
-                sink_shard=None,
+                sink_shard=None, index_imports=index_imports,
             )
         )
         try:
@@ -769,7 +772,7 @@ class Coordinator:
     # -- subscribe ------------------------------------------------------------
     def _sequence_subscribe(self, plan: SubscribePlan) -> ExecuteResult:
         expr = optimize(self._inline_views(plan.expr))
-        imports = self._source_imports(expr)
+        imports, index_imports = self._source_imports(expr)
         self._sub_seq += 1
         # Unique across coordinator restarts: the sink shard is durable,
         # so a process-local counter alone would tail a STALE shard from
@@ -784,6 +787,7 @@ class Coordinator:
                 expr=expr,
                 source_imports=imports,
                 sink_shard=shard,
+                index_imports=index_imports,
             )
         )
         sub = Subscription(self, name, shard, expr.schema(),
@@ -797,23 +801,35 @@ class Coordinator:
         """Replace Get(view) with the view's definition so rendered
         dataflows bottom out at sources (view inlining; the reference
         does this during global optimization). Operators are positional,
-        so the view's internal column names need no reconciliation."""
+        so the view's internal column names need no reconciliation.
+
+        INDEXED views are NOT inlined: a Get of an indexed view becomes
+        an index import of the serving dataflow's device-resident
+        arrangement (TraceManager sharing, arrangement/manager.rs:33) —
+        the whole point of CREATE INDEX is that later dataflows reuse
+        the maintained arrangement instead of recomputing the view."""
 
         def walk(e):
             if isinstance(e, mir.Get):
                 it = self.catalog.items.get(e.name)
-                if it is not None and it.kind == "view":
+                if (
+                    it is not None
+                    and it.kind == "view"
+                    and e.name not in self.peekable
+                ):
                     return walk(it.definition)
                 return e
             return _rewrite_children(e, walk)
 
         return walk(expr)
 
-    def _source_imports(self, expr: mir.RelationExpr) -> dict:
-        """Every FREE Get leaf must be a source subsource, table, or
-        maintained MV shard: name -> (shard, schema). Let/LetRec-bound
-        names are not imports."""
+    def _source_imports(self, expr: mir.RelationExpr) -> tuple:
+        """Every FREE Get leaf resolves to either a shard import
+        (source subsource, table, MV shard) or an INDEX import (an
+        indexed view's serving dataflow). Returns (shard_imports,
+        index_imports). Let/LetRec-bound names are not imports."""
         imports: dict = {}
+        index_imports: dict = {}
 
         def walk(e, bound: frozenset):
             if isinstance(e, mir.Let):
@@ -832,7 +848,12 @@ class Coordinator:
                 it = self.catalog.items.get(e.name)
                 if it is None:
                     raise PlanError(f"unknown relation {e.name!r}")
-                if it.kind in ("source", "materialized-view", "table"):
+                if it.kind == "view" and e.name in self.peekable:
+                    index_imports[e.name] = (
+                        self.peekable[e.name],
+                        it.schema,
+                    )
+                elif it.kind in ("source", "materialized-view", "table"):
                     imports[e.name] = (it.definition["shard"], it.schema)
                 else:
                     raise PlanError(
@@ -843,7 +864,7 @@ class Coordinator:
                 walk(c, bound)
 
         walk(expr, frozenset())
-        return imports
+        return imports, index_imports
 
     def _check_name_free(self, name: str, or_replace: bool = False) -> None:
         """Validate BEFORE durably recording DDL: a poison record that
@@ -859,7 +880,7 @@ class Coordinator:
         if plan.materialized:
             self._check_name_free(plan.name, plan.or_replace)
             inlined = optimize(self._inline_views(expr))
-            imports = self._source_imports(inlined)
+            imports, index_imports = self._source_imports(inlined)
             if record is None:
                 record = self._record_ddl(sql, {"name": plan.name})
             # Shard named by the unique record id: DROP + re-CREATE of
@@ -871,6 +892,7 @@ class Coordinator:
                     expr=inlined,
                     source_imports=imports,
                     sink_shard=shard,
+                    index_imports=index_imports,
                 )
             )
             self.catalog.create(
@@ -928,7 +950,7 @@ class Coordinator:
             expr = mir.Get(plan.on, it.schema)
         else:
             raise PlanError(f"cannot index {it.kind} {plan.on!r}")
-        imports = self._source_imports(expr)
+        imports, index_imports = self._source_imports(expr)
         if not replay:
             self._record_ddl(sql, {"name": plan.name})
         self._register_dataflow(
@@ -937,6 +959,7 @@ class Coordinator:
                 expr=expr,
                 source_imports=imports,
                 sink_shard=None,
+                index_imports=index_imports,
             )
         )
         self.catalog.create(
@@ -1013,6 +1036,19 @@ class Coordinator:
             raise PlanError(
                 f"cannot drop {name!r}: still depended on by {deps}"
             )
+        # Installed dataflows importing this index's arrangement
+        # (TraceManager sharing): dropping the publisher would strand
+        # them mid-maintenance.
+        importers = sorted(
+            dn
+            for dn, pubs in self._index_importers.items()
+            if name in pubs
+        )
+        if importers:
+            raise PlanError(
+                f"cannot drop {name!r}: its arrangement is imported by "
+                f"dataflows {importers}"
+            )
         # Remove the durable record (retract by replayed-sql identity).
         for rec in self._catalog_live_records():
             if rec.get("name") == name:
@@ -1021,9 +1057,11 @@ class Coordinator:
             self.controller.drop_dataflow(name)
             self.peekable.pop(name, None)
             self._df_upstream.pop(name, None)
+            self._index_importers.pop(name, None)
         elif it.kind == "index":
             self.controller.drop_dataflow(name)
             self._df_upstream.pop(name, None)
+            self._index_importers.pop(name, None)
             on = it.definition["on"]
             if self.peekable.get(on) == name:
                 del self.peekable[on]
@@ -1154,9 +1192,16 @@ class Coordinator:
         )
 
     def _register_dataflow(self, desc: DataflowDescription) -> None:
-        self._df_upstream[desc.name] = [
-            sh for sh, _ in desc.source_imports.values()
-        ]
+        # Transitive upstream shards: index imports contribute their
+        # PUBLISHER's upstream so timestamp selection for reads over
+        # shared arrangements still sees the real persist inputs.
+        shards = [sh for sh, _ in desc.source_imports.values()]
+        for pub_name, _schema in desc.index_imports.values():
+            shards += self._df_upstream.get(pub_name, [])
+        self._df_upstream[desc.name] = sorted(set(shards))
+        self._index_importers[desc.name] = {
+            pub for pub, _ in desc.index_imports.values()
+        }
         self.controller.create_dataflow(desc)
 
     def _select_timestamp_shards(self, shards: list[str]) -> int:

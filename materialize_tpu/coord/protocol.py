@@ -102,6 +102,14 @@ class DataflowDescription:
     expr: Any  # mir.RelationExpr
     source_imports: dict  # input name -> (shard_name, Schema)
     sink_shard: str | None = None
+    # input name -> (publisher dataflow name, Schema): the input is the
+    # device-resident output arrangement of an already-installed
+    # dataflow (index import — TraceManager sharing,
+    # compute/src/arrangement/manager.rs:33 + render.rs:384-403);
+    # hydration snapshots the live arrangement instead of replaying the
+    # publisher's sources, and steady-state deltas are pushed
+    # step-by-step.
+    index_imports: dict = field(default_factory=dict)
 
     def fingerprint(self) -> bytes:
         return pickle.dumps(
@@ -110,6 +118,7 @@ class DataflowDescription:
                 self.expr,
                 sorted(self.source_imports.items()),
                 self.sink_shard,
+                sorted(self.index_imports.items()),
             ),
             protocol=pickle.HIGHEST_PROTOCOL,
         )

@@ -25,6 +25,7 @@ import time as _time
 
 from ..render.dataflow import Dataflow
 from ..storage.persist import (
+    IndexSource,
     FileBlob,
     MaintainedView,
     PersistClient,
@@ -231,8 +232,7 @@ class ReplicaWorker:
                     # Another replica's durable chunking won a hydration
                     # race: rebuild this view from the durable shard
                     # (fresh dataflow state; hydrate resumes exactly).
-                    inst.view.expire()
-                    self.dataflows[name] = self._build(inst.desc)
+                    self._rebuild_cascade(name)
                     worked = True
                 except Exception as e:  # halt!-analog, scoped to the df
                     self.dataflows.pop(name, None)
@@ -263,23 +263,91 @@ class ReplicaWorker:
         transient — retry against the fresh durable state."""
         last: Exception | None = None
         for _ in range(5):
+            # Render BEFORE subscribing index sources: a render failure
+            # must not leak subscribers onto publishers (each publisher
+            # step would copy its delta to the orphan forever).
+            df = self._make_dataflow(desc)
+            index_sources: dict = {}
             try:
+                # Index imports resolve against dataflows ALREADY
+                # installed on this replica (command history preserves
+                # install order, so publishers precede subscribers).
+                for name, (pub_name, schema) in getattr(
+                    desc, "index_imports", {}
+                ).items():
+                    pub = self.dataflows.get(pub_name)
+                    if pub is None:
+                        raise RuntimeError(
+                            f"index import {pub_name!r} for dataflow "
+                            f"{desc.name!r} is not installed"
+                        )
+                    index_sources[name] = IndexSource(
+                        pub.view, schema
+                    )
                 return _Installed(
                     desc,
                     MaintainedView(
                         self.client,
-                        self._make_dataflow(desc),
+                        df,
                         desc.source_imports,
                         desc.sink_shard,
+                        index_sources=index_sources,
                     ),
                 )
             except (SinkConflict, Fenced, ValueError) as e:
                 # Fenced: an active-active sibling re-registered the sink
                 # writer mid-hydration (epoch ping-pong) — rebuild picks
                 # up the durable state it wrote.
+                for src in index_sources.values():
+                    src.reader.expire()  # unsubscribe the failed attempt
                 last = e
                 _time.sleep(0.01)
+            except BaseException:
+                for src in index_sources.values():
+                    src.reader.expire()
+                raise
         raise last
+
+    def _dependents_of(self, name: str) -> list[str]:
+        """Installed dataflows that index-import `name`, transitively
+        (subscribers hold a direct reference to the publisher's view, so
+        rebuilding a publisher must cascade to them)."""
+        out: list[str] = []
+        frontier = {name}
+        while frontier:
+            nxt = set()
+            for dn, inst in self.dataflows.items():
+                if dn in out or dn in frontier:
+                    continue
+                pubs = {
+                    p
+                    for p, _s in getattr(
+                        inst.desc, "index_imports", {}
+                    ).values()
+                }
+                if pubs & frontier:
+                    nxt.add(dn)
+            out.extend(sorted(nxt))
+            frontier = nxt
+        return out
+
+    def _rebuild_cascade(self, name: str, new_desc=None) -> None:
+        """Rebuild `name` (optionally with a replacement description)
+        and, in dependency order, every installed dataflow that
+        index-imports it — their IndexSources must re-subscribe to the
+        NEW publisher view."""
+        deps = self._dependents_of(name)
+        inst = self.dataflows.get(name)
+        if inst is not None:
+            inst.view.expire()
+        desc = new_desc if new_desc is not None else inst.desc
+        self.dataflows[name] = self._build(desc)
+        for dn in deps:
+            dinst = self.dataflows.get(dn)
+            if dinst is None:
+                continue
+            dinst.view.expire()
+            self.dataflows[dn] = self._build(dinst.desc)
 
     def _send_status(self, conn, error: str) -> None:
         if conn is None:
@@ -307,10 +375,14 @@ class ReplicaWorker:
             ):
                 existing.reported_upper = -1  # re-report frontier
                 return  # reconciliation: unchanged, keep running
-            if existing is not None:
-                existing.view.expire()  # replaced: release read holds
             try:
-                self.dataflows[desc.name] = self._build(desc)
+                if existing is not None:
+                    # Replaced: rebuild it AND everything that imports
+                    # its arrangement (subscribers hold direct view
+                    # references).
+                    self._rebuild_cascade(desc.name, new_desc=desc)
+                else:
+                    self.dataflows[desc.name] = self._build(desc)
             except Exception as e:
                 # A bad plan must not kill the replica: report and skip
                 # (scoped halt!; the reference would crash-loop the whole

@@ -147,9 +147,22 @@ def _apply_mfp_inner(mfp: MapFilterProject, batch: Batch, time=None) -> Batch:
     )
 
     # Filter: predicate TRUE (not false, not NULL) keeps the row.
+    # Predicates short-circuit left-to-right for ERRORS (the reference's
+    # MfpPlan stops at the first false predicate per row): a predicate's
+    # evaluation errors only count for rows every EARLIER predicate
+    # kept. Map expressions above evaluated unconditionally, as in the
+    # reference.
+    from . import errors as _errors
+
     keep = None
     for p in mfp.predicates:
-        ev = eval_expr(p, full, time)
+        with _errors.collect() as pmasks:
+            ev = eval_expr(p, full, time)
+        for code, mask in pmasks:
+            _errors.emit(
+                code,
+                mask if keep is None else jnp.logical_and(mask, keep),
+            )
         ok = jnp.logical_and(ev.values, jnp.logical_not(ev.null_mask()))
         keep = ok if keep is None else jnp.logical_and(keep, ok)
 

@@ -20,7 +20,8 @@ from .location import (
     VersionedData,
 )
 from .machine import Fenced, Machine, UpperMismatch
-from .operators import MaintainedView, ShardSource, updates_to_batch
+from .operators import (IndexSource, MaintainedView, ShardSource,
+                        updates_to_batch)
 from .state import HollowBatch, ShardState
 
 __all__ = [
@@ -29,6 +30,6 @@ __all__ = [
     "Blob", "Consensus", "ExternalDurabilityError", "FileBlob", "MemBlob",
     "MemConsensus", "SqliteConsensus", "UnreliableBlob", "VersionedData",
     "Fenced", "Machine", "UpperMismatch",
-    "MaintainedView", "ShardSource", "updates_to_batch",
+    "IndexSource", "MaintainedView", "ShardSource", "updates_to_batch",
     "HollowBatch", "ShardState",
 ]

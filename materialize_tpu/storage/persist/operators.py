@@ -100,6 +100,158 @@ class ShardSource:
         return batch
 
 
+def _host_updates(batch: Batch):
+    """Valid rows of a batch as host arrays (cols, nulls, time, diff)."""
+    n = int(batch.count)
+    cols = [np.asarray(a)[:n] for a in batch.cols]
+    nulls = [
+        None if nl is None else np.asarray(nl)[:n] for nl in batch.nulls
+    ]
+    return cols, nulls, np.asarray(batch.time)[:n], np.asarray(
+        batch.diff
+    )[:n]
+
+
+class IndexSource:
+    """Import a live sibling dataflow's output arrangement as an input —
+    the TraceManager sharing analog (compute/src/arrangement/manager.rs:33,
+    index imports at compute/src/render.rs:384-403): hydration snapshots
+    the publisher's device-resident arrangement instead of replaying its
+    sources, and each publisher step pushes its output delta to every
+    subscriber.
+
+    Implements the ShardSource surface (reader shim with
+    machine.reload()/wait_for_upper/expire, snapshot, fetch_to,
+    resume_at) so MaintainedView consumes indexes and shards uniformly.
+    """
+
+    class _State:
+        def __init__(self, since: int, upper: int):
+            self.since = since
+            self.upper = upper
+
+    class _Reader:
+        def __init__(self, src: "IndexSource"):
+            self._src = src
+            self.machine = self
+
+        def reload(self):
+            s = self._src
+            return IndexSource._State(
+                since=max(s.base_upper - 1, 0),
+                upper=s.publisher.upper,
+            )
+
+        def wait_for_upper(self, frontier: int, timeout: float = 30.0):
+            """An upper > frontier. The publisher lives on the SAME
+            replica loop, so instead of blocking we actively step it
+            forward (its own inputs may still not be there — then
+            None, like a shard that never advances)."""
+            s = self._src
+            deadline = _time.monotonic() + timeout
+            while s.publisher.upper <= frontier:
+                if _time.monotonic() > deadline:
+                    return None
+                if not s.publisher.step(
+                    timeout=max(deadline - _time.monotonic(), 0.001)
+                ):
+                    return None
+            return s.publisher.upper
+
+        def expire(self) -> None:
+            s = self._src
+            if s in s.publisher._subscribers:
+                s.publisher._subscribers.remove(s)
+
+    def __init__(self, publisher: "MaintainedView", schema: Schema):
+        self.publisher = publisher
+        self.schema = schema
+        self.reader = IndexSource._Reader(self)
+        # Base = the publisher's CURRENT arrangement (device-resident;
+        # gathered across shards for SPMD publishers). No source replay.
+        self.base = _host_updates(publisher.result_batch())
+        self.base_upper = publisher.upper
+        self._pending: list = []  # (t, (cols, nulls, time, diff))
+        self.frontier: int | None = None
+        publisher._subscribers.append(self)
+
+    def _push(self, t: int, update) -> None:
+        self._pending.append((t, update))
+
+    def _take_until(self, target: int):
+        taken = [u for t, u in self._pending if t < target]
+        self._pending = [
+            (t, u) for t, u in self._pending if t >= target
+        ]
+        return taken
+
+    @staticmethod
+    def _concat(parts):
+        if not parts:
+            return None
+        cols = [
+            np.concatenate([p[0][i] for p in parts])
+            for i in range(len(parts[0][0]))
+        ]
+        nulls = []
+        for i in range(len(parts[0][1])):
+            if all(p[1][i] is None for p in parts):
+                nulls.append(None)
+            else:
+                nulls.append(
+                    np.concatenate(
+                        [
+                            p[1][i]
+                            if p[1][i] is not None
+                            else np.zeros(len(p[3]), dtype=bool)
+                            for p in parts
+                        ]
+                    )
+                )
+        time = np.concatenate([p[2] for p in parts])
+        diff = np.concatenate([p[3] for p in parts])
+        return cols, nulls, time, diff
+
+    def snapshot(self, as_of: int) -> "tuple[Batch, int]":
+        if as_of < self.base_upper - 1:
+            raise ValueError(
+                f"index import cannot rewind to {as_of}: publisher "
+                f"arrangement is at {self.base_upper - 1} (no "
+                "multiversion arrangements)"
+            )
+        parts = [self.base] + self._take_until(as_of + 1)
+        cols, nulls, time, diff = self._concat(parts)
+        self.frontier = as_of + 1
+        return (
+            updates_to_batch(
+                self.schema, cols, nulls, time, diff, as_of
+            ),
+            as_of,
+        )
+
+    def resume_at(self, frontier: int) -> None:
+        self.frontier = frontier
+
+    def fetch_to(self, target: int) -> Batch:
+        assert self.frontier is not None and target > self.frontier - 1
+        parts = self._take_until(target)
+        got = self._concat(parts)
+        if got is None:
+            sch = self.schema
+            cols = [np.zeros(0, c.dtype) for c in sch.columns]
+            got = (
+                cols,
+                [None] * sch.arity,
+                np.zeros(0, np.uint64),
+                np.zeros(0, np.int64),
+            )
+        cols, nulls, time, diff = got
+        self.frontier = target
+        return updates_to_batch(
+            self.schema, cols, nulls, time, diff, target - 1
+        )
+
+
 class MaintainedView:
     """An installed dataflow maintained between shards: sources -> step ->
     optional output shard. One shard per source name; with a sink, the
@@ -109,7 +261,8 @@ class MaintainedView:
     exactly because the upper didn't advance). Without a sink this is an
     INDEX: the output arrangement lives on device, peekable, and the
     frontier is in-memory (restart = full rehydration from inputs, the
-    reference's index model)."""
+    reference's index model). Other dataflows may import the index via
+    IndexSource; each step's output delta is pushed to subscribers."""
 
     def __init__(
         self,
@@ -117,13 +270,18 @@ class MaintainedView:
         dataflow: Dataflow,
         source_shards: dict[str, tuple[str, Schema]],
         output_shard: str | None,
+        index_sources: dict[str, "IndexSource"] | None = None,
     ):
         self.client = client
         self.df = dataflow
+        self._subscribers: list = []
         self.sources = {
             name: ShardSource(client.open_reader(shard), schema)
             for name, (shard, schema) in source_shards.items()
         }
+        if index_sources:
+            self.sources.update(index_sources)
+        self._output_shard = output_shard
         self.writer: WriteHandle | None = (
             client.open_writer(output_shard, dataflow.out_schema)
             if output_shard is not None
@@ -206,20 +364,108 @@ class MaintainedView:
             self._upper = as_of + 1
         else:
             as_of = out_upper - 1
+            # Index imports cannot rewind: the publisher arrangement is
+            # live at base_upper-1, which may be past the sink upper.
+            # Hydrate at the furthest input instead and append ONE
+            # correction chunk (desired snapshot ⊖ durable sink content)
+            # covering the skipped interval — the reference's v2 sink
+            # correction-buffer model (sink/correction_v2.rs).
+            min_feasible = max(
+                (
+                    s.base_upper - 1
+                    for s in self.sources.values()
+                    if isinstance(s, IndexSource)
+                ),
+                default=as_of,
+            )
+            corrected_as_of = max(as_of, min_feasible)
+            for s in self.sources.values():
+                if s.reader.wait_for_upper(
+                    corrected_as_of, timeout=30.0
+                ) is None:
+                    raise TimeoutError(
+                        "input upper never passed resume as_of "
+                        f"{corrected_as_of}"
+                    )
             inputs = {}
             for name, s in self.sources.items():
-                b, _ = s.snapshot(as_of)
+                b, _ = s.snapshot(corrected_as_of)
                 inputs[name] = b
-            self.df.time = as_of
-            self.df.step(inputs)  # rebuild arrangements; output delta
-            # already durable — do NOT append.
-            self._upper = out_upper
+            self.df.time = corrected_as_of
+            self.df.step(inputs)  # rebuild arrangements
+            if corrected_as_of == as_of:
+                # output delta already durable — do NOT append.
+                self._upper = out_upper
+            else:
+                self._append_correction(out_upper, corrected_as_of)
+                self._upper = corrected_as_of + 1
 
 
     def result_batch(self) -> Batch:
         """The maintained output arrangement as a HOST-readable batch
         (SPMD dataflows gather their per-worker shards first)."""
         return self.df.gather_delta(self.df.output.batch)
+
+    def _append_correction(self, out_upper: int, as_of: int) -> None:
+        """One chunk [out_upper, as_of+1) bringing the durable sink to
+        the freshly hydrated snapshot: correction = desired ⊖ durable
+        (the v2 sink correction-buffer model, sink/correction_v2.rs).
+        Used when an index import cannot rewind to the sink upper."""
+        if self.writer is None:
+            return
+
+        def acc_multiset(cols, nulls, diff):
+            acc: dict = {}
+            n = len(diff)
+            for i in range(n):
+                key = tuple(
+                    None
+                    if nulls[j] is not None and nulls[j][i]
+                    else cols[j][i].item()
+                    for j in range(len(cols))
+                )
+                acc[key] = acc.get(key, 0) + int(diff[i])
+            return acc
+
+        cols, nulls, _t, diff = _host_updates(self.result_batch())
+        desired = acc_multiset(cols, nulls, diff)
+        reader = self.client.open_reader(
+            self._output_shard, "sink-correction"
+        )
+        try:
+            _sch, dcols, dnulls, _dt, ddiff = reader.snapshot(
+                out_upper - 1
+            )
+        finally:
+            reader.expire()
+        durable = acc_multiset(dcols, dnulls, ddiff)
+        delta: dict = {}
+        for k in set(desired) | set(durable):
+            d = desired.get(k, 0) - durable.get(k, 0)
+            if d:
+                delta[k] = d
+        schema = self.df.out_schema
+        rows = list(delta.items())
+        out_cols, out_nulls = [], []
+        for j, c in enumerate(schema.columns):
+            vals = np.asarray(
+                [0 if k[j] is None else k[j] for k, _ in rows],
+                dtype=c.dtype,
+            )
+            out_cols.append(vals)
+            out_nulls.append(
+                np.asarray([k[j] is None for k, _ in rows])
+                if any(k[j] is None for k, _ in rows)
+                else None
+            )
+        batch = Batch.from_numpy(
+            schema,
+            out_cols,
+            np.full(len(rows), as_of, np.uint64),
+            np.asarray([d for _, d in rows], np.int64),
+            nulls=out_nulls,
+        )
+        self._append(batch, out_upper, as_of + 1, as_of)
 
     def _append(self, batch: Batch, lower: int, upper: int, t: int) -> None:
         """Append the step's output delta. In active-active replication
@@ -289,6 +535,7 @@ class MaintainedView:
             out = self.df.step({})
             out = self.df.gather_delta(out)
             self._append(out, 0, 1, 0)
+            self._publish(0, out)
             self._upper = 1
             return True
         target = None
@@ -311,8 +558,19 @@ class MaintainedView:
         out = self.df.step(polled)
         out = self.df.gather_delta(out)  # no-op on single-device
         self._append(out, lower, target, t)
+        self._publish(t, out)
         self._upper = target
         return True
+
+    def _publish(self, t: int, out: Batch) -> None:
+        """Push this step's output delta to index-import subscribers
+        (TraceManager sharing: the subscriber's dataflow sees exactly
+        the arrangement's change stream)."""
+        if not self._subscribers:
+            return
+        update = _host_updates(out)
+        for sub in self._subscribers:
+            sub._push(t, update)
 
     def run_until(self, frontier: int, timeout: float = 30.0) -> None:
         """Advance until the output upper reaches ``frontier``."""

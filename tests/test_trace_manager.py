@@ -1,0 +1,127 @@
+"""Cross-dataflow arrangement sharing (TraceManager analog).
+
+Reference: compute/src/arrangement/manager.rs:33 + index imports at
+compute/src/render.rs:384-403 — one CREATE INDEX serves every later
+dataflow and peek: a second dataflow over an indexed collection imports
+the maintained arrangement (snapshot + pushed deltas) instead of
+replaying the collection's sources.
+"""
+
+import socket
+import threading
+
+import pytest
+
+from materialize_tpu.coord.coordinator import Coordinator
+from materialize_tpu.coord.protocol import PersistLocation
+from materialize_tpu.coord.replica import serve_forever
+from materialize_tpu.storage.persist import (
+    FileBlob,
+    PersistClient,
+    SqliteConsensus,
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def coord(tmp_path):
+    loc = PersistLocation(
+        str(tmp_path / "blob"), str(tmp_path / "consensus.db")
+    )
+    port = _free_port()
+    ready = threading.Event()
+    threading.Thread(
+        target=serve_forever, args=(port, loc, "r0", ready), daemon=True
+    ).start()
+    assert ready.wait(10)
+    c = Coordinator(
+        PersistClient(
+            FileBlob(loc.blob_root), SqliteConsensus(loc.consensus_path)
+        )
+    )
+    c.add_replica("r0", ("127.0.0.1", port))
+    yield c
+    c.shutdown()
+
+
+def _rows(res):
+    return sorted(tuple(r) for r in res.rows)
+
+
+class TestArrangementSharing:
+    def test_second_dataflow_imports_index(self, coord):
+        coord.execute(
+            "CREATE TABLE t (k bigint NOT NULL, v bigint NOT NULL)"
+        )
+        coord.execute(
+            "INSERT INTO t VALUES (1, 10), (1, 20), (2, 30)"
+        )
+        coord.execute(
+            "CREATE VIEW agg AS SELECT k, sum(v) AS s FROM t GROUP BY k"
+        )
+        coord.execute("CREATE INDEX agg_idx ON agg")
+
+        # Peeks of the view are served from the shared index arrangement.
+        assert _rows(coord.execute("SELECT * FROM agg")) == [
+            (1, 30),
+            (2, 30),
+        ]
+
+        # A second dataflow over the indexed view must IMPORT the index:
+        # its description carries an index import of agg_idx and does
+        # NOT read t's shard.
+        coord.execute(
+            "CREATE MATERIALIZED VIEW top AS "
+            "SELECT k FROM agg WHERE s >= 30"
+        )
+        desc = coord.controller._dataflows["top"]["desc"]
+        assert desc.index_imports == {
+            "agg": ("agg_idx", coord.catalog.items["agg"].schema)
+        }
+        assert desc.source_imports == {}
+
+        assert _rows(coord.execute("SELECT * FROM top")) == [(1,), (2,)]
+
+        # Deltas propagate through the shared arrangement: new inputs
+        # flow source -> index dataflow -> importing dataflow.
+        coord.execute("INSERT INTO t VALUES (3, 5)")
+        assert _rows(coord.execute("SELECT * FROM agg")) == [
+            (1, 30),
+            (2, 30),
+            (3, 5),
+        ]
+        assert _rows(coord.execute("SELECT * FROM top")) == [(1,), (2,)]
+        coord.execute("INSERT INTO t VALUES (3, 25)")
+        assert _rows(coord.execute("SELECT * FROM top")) == [
+            (1,),
+            (2,),
+            (3,),
+        ]
+        # Retractions propagate too.
+        coord.execute("DELETE FROM t WHERE k = 1")
+        assert _rows(coord.execute("SELECT * FROM top")) == [(2,), (3,)]
+
+    def test_transient_select_uses_index(self, coord):
+        coord.execute("CREATE TABLE u (x bigint NOT NULL)")
+        coord.execute("INSERT INTO u VALUES (1), (2), (3)")
+        coord.execute(
+            "CREATE VIEW du AS SELECT x, x * 2 AS y FROM u"
+        )
+        coord.execute("CREATE INDEX du_idx ON du")
+        # Transient SELECT over the indexed view: planned as an index
+        # import (no inlining back to u).
+        res = coord.execute("SELECT y FROM du WHERE x > 1")
+        assert _rows(res) == [(4,), (6,)]
+        coord.execute("INSERT INTO u VALUES (10)")
+        assert _rows(coord.execute("SELECT y FROM du WHERE x > 1")) == [
+            (4,),
+            (6,),
+            (20,),
+        ]
