@@ -597,7 +597,20 @@ def _build_letrec(expr: mir.LetRec, ctx: _RenderContext):
             return out
 
         def run_values(states_l, it_inputs):
-            """One iteration: returns (new_states_list, deltas, ovf dict)."""
+            """One iteration: returns (new_states_list, deltas, ovf dict).
+
+            Error-stream masks raised INSIDE the fixpoint are contained
+            in a local sink and dropped: values created inside the
+            while_loop trace cannot ride the outer step's err collection
+            (they would escape the loop as leaked tracers). Documented
+            v1 limitation: scalar-eval errors inside WITH MUTUALLY
+            RECURSIVE values do not reach the err output."""
+            from ..expr import errors as _errors
+
+            with _errors.step_scope():
+                return _run_values_inner(states_l, it_inputs)
+
+        def _run_values_inner(states_l, it_inputs):
             states_l = list(states_l)
             ovf = {}
             deltas = []

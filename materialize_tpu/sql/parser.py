@@ -491,7 +491,15 @@ class Parser:
         # e.g. double precision / timestamp with time zone (one word here)
         if parts[0] == "double" and self.peek().text == "precision":
             parts.append(self.expect_ident())
-        return " ".join(parts)
+        name = " ".join(parts)
+        # parameterized types: decimal(12,2), varchar(10), ...
+        if self.accept_sym("("):
+            args = [self.expect_ident_or_number()]
+            while self.accept_sym(","):
+                args.append(self.expect_ident_or_number())
+            self.expect_sym(")")
+            name += "(" + ",".join(args) + ")"
+        return name
 
     def _set_expr(self) -> ast.SetExpr:
         left = self._set_atom()

@@ -235,9 +235,12 @@ class QueryPlanner:
                 raise PlanError(
                     "WITH MUTUALLY RECURSIVE bindings need (name type, ...)"
                 )
-            cols = [
-                Column(n, type_from_name(t), True) for n, t in cte.columns
-            ]
+            from .hir import parse_type
+
+            cols = []
+            for n, t in cte.columns:
+                ty, scale = parse_type(t)
+                cols.append(Column(n, ty, True, scale))
             sch = Schema(cols)
             names.append(cte.name)
             value_schemas.append(sch)
@@ -440,9 +443,18 @@ class QueryPlanner:
         pre_scalars: list = []
         key_indices: list[int] = []
         resolved_keys: list[ast.Expr] = []
+        aliases = {name: e for e, name in items}
         for ge in key_sources:
             if isinstance(ge, ast.NumberLit):  # GROUP BY 1
                 e, _ = items[int(ge.text) - 1]
+            elif (
+                isinstance(ge, ast.Ident)
+                and len(ge.parts) == 1
+                and scope.maybe_resolve(ge.parts) is None
+                and ge.parts[0] in aliases
+            ):
+                # GROUP BY <select alias> (a real column wins, pg-style)
+                e = aliases[ge.parts[0]]
             else:
                 e = ge
             resolved_keys.append(e)
