@@ -518,15 +518,10 @@ def serve_forever(
     replica_id: str = "r0",
     ready_event: threading.Event | None = None,
     workers: int = 1,
-    worker_out: list | None = None,
 ) -> None:
     worker = ReplicaWorker(
         location=location, replica_id=replica_id, workers=workers
     )
-    if worker_out is not None:
-        # Hand the worker to the caller (tests stop leaked replicas:
-        # a replica left running keeps stepping its dataflows forever).
-        worker_out.append(worker)
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     sock.bind(("127.0.0.1", port))

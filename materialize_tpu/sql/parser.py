@@ -270,28 +270,14 @@ class Parser:
         columns = []
         while True:
             col = self.expect_ident()
-            type_parts = [self.expect_ident()]
-            if (
-                type_parts[0].lower() == "double"
-                and self.peek().kind is TokKind.IDENT
-                and self.peek().text.lower() == "precision"
-            ):
-                self.next()
-                type_parts[0] = "double precision"
-            # numeric(p, s) / decimal(p, s)
-            if self.accept_sym("("):
-                args = [self.expect_ident_or_number()]
-                while self.accept_sym(","):
-                    args.append(self.expect_ident_or_number())
-                self.expect_sym(")")
-                type_parts.append("(" + ",".join(args) + ")")
+            ty = self._type_name()
             nullable = True
             if self.accept_kw("not"):
                 self.expect_kw("null")
                 nullable = False
             elif self.accept_kw("null"):
                 pass
-            columns.append((col, "".join(type_parts), nullable))
+            columns.append((col, ty, nullable))
             if not self.accept_sym(","):
                 break
         self.expect_sym(")")

@@ -45,7 +45,6 @@ from .hir import (
     PlanError,
     Scope,
     ScopeItem,
-    type_from_name,
     typ_of,
 )
 

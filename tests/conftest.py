@@ -51,3 +51,35 @@ def _hard_exit():
 
 
 atexit.register(_hard_exit)
+
+
+# -- replica-worker leak control ---------------------------------------------
+# Many tests spawn in-process ReplicaWorkers via serve_forever threads and
+# never stop them; a leaked replica keeps STEPPING its installed dataflows
+# for the remainder of the suite. The accumulation starves later tests
+# (observed: the suite slowing from ~12 to ~35 minutes) and has triggered
+# segfaults in concurrent XLA compile-cache loads. Track every worker
+# created during a test and stop it at teardown.
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _stop_leaked_replica_workers(monkeypatch):
+    from materialize_tpu.coord import replica as _replica_mod
+
+    created: list = []
+    orig_init = _replica_mod.ReplicaWorker.__init__
+
+    def tracking_init(self, *a, **k):
+        orig_init(self, *a, **k)
+        created.append(self)
+
+    monkeypatch.setattr(
+        _replica_mod.ReplicaWorker, "__init__", tracking_init
+    )
+    yield
+    for w in created:
+        try:
+            w.stop()
+        except Exception:
+            pass

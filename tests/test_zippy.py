@@ -67,7 +67,6 @@ class TestZippy:
         )
 
         replicas = {}
-        workers: list = []
 
         def start_replica(rid):
             s = socket.socket()
@@ -78,7 +77,6 @@ class TestZippy:
             threading.Thread(
                 target=serve_forever,
                 args=(port, loc, rid, ready),
-                kwargs={"worker_out": workers},
                 daemon=True,
             ).start()
             assert ready.wait(10)
@@ -219,10 +217,6 @@ class TestZippy:
             act_validate()
             assert not errors, errors
         finally:
-            # Even on failure: a leaked replica keeps stepping its
-            # dataflows forever, and a pile of them across seeds starves
-            # later tests (and has triggered segfaults in concurrent XLA
-            # compile-cache loads).
+            # Replica workers are stopped by the conftest autouse
+            # fixture (leak control); only the coordinator is ours.
             coord.shutdown()
-            for w in workers:
-                w.stop()
