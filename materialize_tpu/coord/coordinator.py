@@ -684,8 +684,12 @@ class Coordinator:
                     name, as_of=as_of, timeout=PEEK_TIMEOUT
                 )
         finally:
+            # Deregister FIRST: the dict pops cannot fail, while
+            # drop_dataflow's broadcast can (dead replica socket) — a
+            # raise there must not leave a stale _index_importers entry
+            # blocking DROP INDEX on the publisher forever.
+            self._deregister_dataflow(name)
             self.controller.drop_dataflow(name)
-            self._df_upstream.pop(name, None)
         return rows
 
     def _read_rows_multiset(self, expr: mir.RelationExpr) -> dict:
@@ -1054,14 +1058,12 @@ class Coordinator:
             if rec.get("name") == name:
                 self._catalog_append(rec, -1)
         if it.kind == "materialized-view":
+            self._deregister_dataflow(name)
             self.controller.drop_dataflow(name)
             self.peekable.pop(name, None)
-            self._df_upstream.pop(name, None)
-            self._index_importers.pop(name, None)
         elif it.kind == "index":
+            self._deregister_dataflow(name)
             self.controller.drop_dataflow(name)
-            self._df_upstream.pop(name, None)
-            self._index_importers.pop(name, None)
             on = it.definition["on"]
             if self.peekable.get(on) == name:
                 del self.peekable[on]
@@ -1202,7 +1204,20 @@ class Coordinator:
         self._index_importers[desc.name] = {
             pub for pub, _ in desc.index_imports.values()
         }
-        self.controller.create_dataflow(desc)
+        try:
+            self.controller.create_dataflow(desc)
+        except BaseException:
+            # A failed install must not leave importer bookkeeping that
+            # would permanently block DROP INDEX on the publisher.
+            self._deregister_dataflow(desc.name)
+            raise
+
+    def _deregister_dataflow(self, name: str) -> None:
+        """Forget a dataflow's upstream + importer bookkeeping. Every
+        drop path must come through here: a stale _index_importers entry
+        permanently blocks DROP INDEX on the publisher."""
+        self._df_upstream.pop(name, None)
+        self._index_importers.pop(name, None)
 
     def _select_timestamp_shards(self, shards: list[str]) -> int:
         """Timestamp selection (coord/timestamp_selection.rs): the latest
@@ -1271,8 +1286,8 @@ class Subscription:
         self.coord.subscriptions = {
             k: v for k, v in self.coord.subscriptions.items() if v is not self
         }
+        self.coord._deregister_dataflow(self.df_name)
         self.coord.controller.drop_dataflow(self.df_name)
-        self.coord._df_upstream.pop(self.df_name, None)
         self.reader.expire()
 
 

@@ -292,6 +292,7 @@ class ReplicaWorker:
                         desc.source_imports,
                         desc.sink_shard,
                         index_sources=index_sources,
+                        replica_id=self.replica_id,
                     ),
                 )
             except (SinkConflict, Fenced, ValueError) as e:

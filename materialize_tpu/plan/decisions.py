@@ -9,7 +9,6 @@ decisions feed both EXPLAIN and rendering).
 from __future__ import annotations
 
 from ..expr import relation as mir
-from ..expr.relation import AggregateFunc
 from ..expr.scalar import ColumnRef
 from .lir import (
     JoinPlan,

@@ -42,19 +42,30 @@ _IDS = itertools.count()
 
 
 def lower(rel: h.HirRelation) -> mir.RelationExpr:
+    """Public entry: lower one HIR relation to MIR, scoping the
+    free-ref memo (hir._FREE_CACHE) to this pass — the memo keys by
+    id() and keeps strong references, so leaving it populated across
+    statements grows coordinator memory without bound."""
+    try:
+        return _lower(rel)
+    finally:
+        h._FREE_CACHE.clear()
+
+
+def _lower(rel: h.HirRelation) -> mir.RelationExpr:
     if isinstance(rel, h.HGet):
         return mir.Get(rel.name, rel._schema)
     if isinstance(rel, h.HConstant):
         return mir.Constant(rel.rows, rel._schema)
     if isinstance(rel, h.HRename):
-        inner = lower(rel.input)
+        inner = _lower(rel.input)
         return _rename(inner, rel._schema)
     if isinstance(rel, h.HProject):
-        return mir.Project(lower(rel.input), tuple(rel.outputs))
+        return mir.Project(_lower(rel.input), tuple(rel.outputs))
     if isinstance(rel, h.HMap):
-        return _lower_map(lower(rel.input), rel, shift=0, cmap={})
+        return _lower_map(_lower(rel.input), rel, shift=0, cmap={})
     if isinstance(rel, h.HFilter):
-        cur = lower(rel.input)
+        cur = _lower(rel.input)
         base = _arity(cur)
         return _lower_filter_preds(
             cur, rel.predicates, keep_arity=base, shift=0, cmap={}
@@ -62,34 +73,34 @@ def lower(rel: h.HirRelation) -> mir.RelationExpr:
     if isinstance(rel, h.HJoin):
         return _lower_join(rel)
     if isinstance(rel, h.HReduce):
-        return _lower_reduce(lower(rel.input), rel, shift=0, cmap={})
+        return _lower_reduce(_lower(rel.input), rel, shift=0, cmap={})
     if isinstance(rel, h.HDistinct):
-        inner = lower(rel.input)
+        inner = _lower(rel.input)
         return mir.Reduce(
             inner, tuple(range(rel.input.schema().arity)), ()
         )
     if isinstance(rel, h.HTopK):
         return mir.TopK(
-            lower(rel.input),
+            _lower(rel.input),
             tuple(rel.group_key),
             tuple(rel.order_by),
             rel.limit,
             rel.offset,
         )
     if isinstance(rel, h.HNegate):
-        return mir.Negate(lower(rel.input))
+        return mir.Negate(_lower(rel.input))
     if isinstance(rel, h.HThreshold):
-        return mir.Threshold(lower(rel.input))
+        return mir.Threshold(_lower(rel.input))
     if isinstance(rel, h.HUnion):
-        return mir.Union(tuple(lower(i) for i in rel.inputs))
+        return mir.Union(tuple(_lower(i) for i in rel.inputs))
     if isinstance(rel, h.HLet):
-        return mir.Let(rel.name, lower(rel.value), lower(rel.body))
+        return mir.Let(rel.name, _lower(rel.value), _lower(rel.body))
     if isinstance(rel, h.HLetRec):
         return mir.LetRec(
             tuple(rel.names),
-            tuple(lower(v) for v in rel.values),
+            tuple(_lower(v) for v in rel.values),
             tuple(rel.value_schemas),
-            lower(rel.body),
+            _lower(rel.body),
             rel.max_iters,
         )
     raise NotImplementedError(type(rel).__name__)
@@ -546,7 +557,7 @@ def _apply(kname: str, kschema: Schema, rel: h.HirRelation, cmap: dict):
     ka = kschema.arity
     kget = mir.Get(kname, kschema)
     if not h.is_correlated(rel):
-        return mir.Join((kget, lower(rel)), equivalences=())
+        return mir.Join((kget, _lower(rel)), equivalences=())
     if isinstance(rel, h.HRename):
         return _apply(kname, kschema, rel.input, cmap)
     if isinstance(rel, h.HProject):
@@ -617,7 +628,7 @@ def _apply(kname: str, kschema: Schema, rel: h.HirRelation, cmap: dict):
             raise NotImplementedError("correlated CTE value")
         return mir.Let(
             rel.name,
-            lower(rel.value),
+            _lower(rel.value),
             _apply(kname, kschema, rel.body, cmap),
         )
     raise NotImplementedError(
@@ -708,8 +719,8 @@ def _split_on(on, l_arity: int, r_arity: int):
 
 
 def _lower_join(rel: h.HJoin) -> mir.RelationExpr:
-    left = lower(rel.left)
-    right = lower(rel.right)
+    left = _lower(rel.left)
+    right = _lower(rel.right)
     la, ra = _arity(left), _arity(right)
     equivs, residual = _split_on(rel.on, la, ra)
     inner = mir.Join((left, right), equivalences=tuple(equivs))

@@ -271,8 +271,10 @@ class MaintainedView:
         source_shards: dict[str, tuple[str, Schema]],
         output_shard: str | None,
         index_sources: dict[str, "IndexSource"] | None = None,
+        replica_id: str = "r0",
     ):
         self.client = client
+        self.replica_id = replica_id
         self.df = dataflow
         self._subscribers: list = []
         self.sources = {
@@ -429,8 +431,18 @@ class MaintainedView:
 
         cols, nulls, _t, diff = _host_updates(self.result_batch())
         desired = acc_multiset(cols, nulls, diff)
+        # Reader id is stable PER REPLICA: distinct across active-active
+        # siblings (a shared identity would let one replica's expire()
+        # release the other's since hold mid-snapshot), but stable across
+        # restarts of the same replica so a hold leaked by a crash
+        # between open and expire is re-registered and released by the
+        # next hydration (this persist analog has no lease expiry).
+        # Known caveat: a replica crashed in this window and then
+        # decommissioned forever leaks its hold — fixing that needs
+        # lease-based reader expiry (persist-client/src/read.rs leases),
+        # tracked with the read-hold/read-policy work.
         reader = self.client.open_reader(
-            self._output_shard, "sink-correction"
+            self._output_shard, f"sink-correction-{self.replica_id}"
         )
         try:
             _sch, dcols, dnulls, _dt, ddiff = reader.snapshot(
