@@ -2,12 +2,27 @@
 
 Analog of differential arrangements/spines (reference:
 doc/developer/arrangements.md; row-spine/src/lib.rs; shared via
-TraceManager, compute/src/arrangement/manager.rs:33). v0 keeps a single
-fully-consolidated sorted run per arrangement ("fully compacted spine"):
-inserts merge-path + consolidate into a new run. Historical multiversion
-reads are deferred — with barrier-synchronous micro-batch steps every
-reader sees the state exactly at the step frontier, which matches the
-reference's behavior when logical compaction keeps `since` at the frontier.
+TraceManager, compute/src/arrangement/manager.rs:33). Two forms:
+
+- ``Arrangement``: a single fully-consolidated sorted run. Inserts
+  merge-path + consolidate into a new run — O(state) per step. Used
+  where operator state is output-sized (Reduce groups, distinct keys,
+  TopK windows).
+
+- ``Spine``: the amortized two-run form for input-sized state (join
+  arrangements, the output index). Per-step inserts touch only the
+  small ``tail`` run (O(tail)); the host periodically dispatches a
+  separate ``compact_spine`` program that merges the tail into the
+  large ``base`` run — the analog of differential's amortized spine
+  merges (row-spine/src/lib.rs:10-14, arrangement_exert_proportionality
+  at cluster-client/src/client.rs:26-34). Readers see base ⊎ tail
+  (multiset sum): lookups probe both runs; a row may appear in both
+  with cancelling diffs, which downstream consolidation resolves.
+
+Historical multiversion reads are deferred — with barrier-synchronous
+micro-batch steps every reader sees the state exactly at the step
+frontier, which matches the reference's behavior when logical compaction
+keeps `since` at the frontier.
 """
 
 from __future__ import annotations
@@ -71,6 +86,12 @@ class Arrangement:
     def empty(schema: Schema, key, capacity: int = 256) -> "Arrangement":
         return Arrangement(Batch.empty(schema, capacity), tuple(key))
 
+    def map_batches(self, fn) -> "Arrangement":
+        """Rebuild with ``fn`` applied to the contained batch (shared
+        shape-management protocol with Spine: replication, count
+        reshaping, growth)."""
+        return Arrangement(fn(self.batch), self.key)
+
 
 def arrange(batch: Batch, key, capacity: int | None = None) -> Arrangement:
     """Sort+consolidate a batch into an Arrangement (build from scratch)."""
@@ -124,3 +145,110 @@ def lookup_range(arr: Arrangement, probe_lanes) -> tuple:
     lo = lex_searchsorted(lanes, arr.batch.count, probe_lanes, side="left")
     hi = lex_searchsorted(lanes, arr.batch.count, probe_lanes, side="right")
     return lo, hi
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Spine:
+    """Amortized two-run arrangement: ``base`` (large, consolidated) plus
+    ``tail`` (small, absorbs per-step deltas). Logical content is the
+    multiset sum of both runs; each run is individually sorted by the
+    arrangement order (key columns then remaining columns) and
+    consolidated, but the SAME row may appear in both runs — readers
+    must combine (probe both runs; sum diffs downstream).
+
+    The point: per-step insert cost is O(tail capacity), independent of
+    state size, so a 2^20-row arrangement can absorb 4k-row deltas
+    without a full-state pass per step. The O(base) merge happens in a
+    separate host-scheduled ``compact_spine`` dispatch every K steps —
+    amortized cost O(base * delta / tail) per step, the differential
+    spine's geometric-merge budget re-cast for fixed XLA shapes.
+    """
+
+    base: Batch
+    tail: Batch
+    key: tuple  # static: key column indices
+
+    def tree_flatten(self):
+        return (self.base, self.tail), (self.key,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        (key,) = aux
+        return cls(children[0], children[1], key)
+
+    @property
+    def schema(self) -> Schema:
+        return self.base.schema
+
+    @property
+    def capacity(self) -> int:
+        """Base-run capacity (the state-size tier)."""
+        return self.base.capacity
+
+    @property
+    def tail_capacity(self) -> int:
+        return self.tail.capacity
+
+    def runs(self) -> tuple[Arrangement, Arrangement]:
+        """Single-run views for lookup/probe code (base first)."""
+        return (
+            Arrangement(self.base, self.key),
+            Arrangement(self.tail, self.key),
+        )
+
+    def map_batches(self, fn) -> "Spine":
+        return Spine(fn(self.base), fn(self.tail), self.key)
+
+    @staticmethod
+    def empty(
+        schema: Schema, key, capacity: int = 256, tail_capacity: int = 1024
+    ) -> "Spine":
+        return Spine(
+            Batch.empty(schema, capacity),
+            Batch.empty(schema, tail_capacity),
+            tuple(key),
+        )
+
+
+def insert_tail(spine: Spine, delta: Batch) -> tuple[Spine, jnp.ndarray]:
+    """Merge a delta batch into the spine's tail run only — the hot-path
+    insert. O(tail capacity); the base run is untouched (no copy: it
+    passes through the step as the same buffer).
+
+    Returns (new_spine, tail_overflowed). On overflow the host grows the
+    tail tier (or compacts more often) and replays."""
+    d = arrange(delta, spine.key, capacity=None)
+    tail_arr = Arrangement(spine.tail, spine.key)
+    merged, overflow = merge_sorted(
+        spine.tail,
+        tail_arr.sort_lanes(),
+        d.batch,
+        d.sort_lanes(),
+        spine.tail.capacity,
+    )
+    m = Arrangement(merged, spine.key)
+    cons = consolidate_sorted(merged, m.sort_lanes())
+    return Spine(spine.base, cons, spine.key), overflow
+
+
+def compact_spine(spine: Spine) -> tuple[Spine, jnp.ndarray]:
+    """Merge the tail into the base: the amortized O(base) spine merge,
+    dispatched by the host every K steps (and before peeks/snapshots).
+    Sort-free: both runs are sorted by the same lanes, so the merge is a
+    merge-path scatter + consolidate_sorted — compile cost stays flat in
+    state capacity (PERF_NOTES.md fact 4 is about sorts, not scatters).
+
+    Returns (new_spine with empty tail, base_overflowed)."""
+    base_arr, tail_arr = spine.runs()
+    merged, overflow = merge_sorted(
+        spine.base,
+        base_arr.sort_lanes(),
+        spine.tail,
+        tail_arr.sort_lanes(),
+        spine.base.capacity,
+    )
+    m = Arrangement(merged, spine.key)
+    cons = consolidate_sorted(merged, m.sort_lanes())
+    empty_tail = spine.tail.replace(count=jnp.zeros_like(spine.tail.count))
+    return Spine(cons, empty_tail, spine.key), overflow

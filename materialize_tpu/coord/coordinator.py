@@ -156,7 +156,7 @@ class Coordinator:
         code = GLOBAL_DICT.encode(json.dumps(record, sort_keys=True))
         t = self._cat_writer.upper
         self._cat_writer.compare_and_append(
-            [np.array([code], np.int32)],
+            [np.array([code], np.int64)],
             [None],
             np.array([t], np.uint64),
             np.array([diff], np.int64),
@@ -1143,7 +1143,7 @@ class Coordinator:
 
         df = Dataflow(subst(expr))
         df.step({})
-        rows = _decode_peek_rows(df.output.batch)
+        rows = _decode_peek_rows(df.output_batch())
         return ExecuteResult(
             "rows",
             rows=_finish(rows, plan.order_by,

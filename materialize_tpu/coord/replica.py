@@ -496,9 +496,7 @@ class ReplicaWorker:
                 # One small device->host read, only on frontier change.
                 import numpy as _np
 
-                records[name] = int(
-                    _np.asarray(inst.view.df.output.batch.count).sum()
-                )
+                records[name] = inst.view.df.output_records()
         if changed:
             ctp.send_msg(
                 conn,

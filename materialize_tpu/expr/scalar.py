@@ -347,9 +347,15 @@ class CallBinary(ScalarExpr):
             )
         if self.func == BinaryFunc.DIV:
             # SQL: division may produce NULL (div by zero -> error in MZ;
-            # we produce NULL for now) and floats for non-decimals.
+            # we produce NULL for now). int/int is INTEGER division
+            # truncating toward zero (pg int4div/int8div); decimals keep
+            # the left scale; anything float goes float.
             if lt_.ctype is ColumnType.DECIMAL:
                 return Column("f", ColumnType.DECIMAL, True, lt_.scale)
+            if lt_.ctype in (
+                ColumnType.INT32, ColumnType.INT64
+            ) and rt.ctype in (ColumnType.INT32, ColumnType.INT64):
+                return Column("f", ColumnType.INT64, True)
             return Column("f", ColumnType.FLOAT64, True)
         # arithmetic: unify types
         ctype, scale = _unify_arith(lt_, rt, self.func)
@@ -664,21 +670,10 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
             BinaryFunc.GT,
             BinaryFunc.GTE,
         ):
-            if (
-                l.col.ctype is ColumnType.STRING
-                and r.col.ctype is ColumnType.STRING
-                and f not in (BinaryFunc.EQ, BinaryFunc.NEQ)
-            ):
-                # dictionary codes are insertion-ordered; ordering
-                # comparisons go through the lexicographic rank table
-                from . import strings
-
-                rank = strings.trace_env()["rank"]
-                hi = rank.shape[0] - 1
-                lv = rank[jnp.clip(l.values, 0, hi)]
-                rv = rank[jnp.clip(r.values, 0, hi)]
-            else:
-                lv, rv = _coerce_comparable(l, r)
+            # Strings compare directly: dictionary codes are
+            # order-preserving labels (repr/schema.py StringDictionary),
+            # so integer comparison == lexicographic comparison.
+            lv, rv = _coerce_comparable(l, r)
             op = {
                 BinaryFunc.EQ: jnp.equal,
                 BinaryFunc.NEQ: jnp.not_equal,
@@ -728,6 +723,25 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
         if f == BinaryFunc.DIV:
             from . import errors as _err
 
+            if col.ctype is ColumnType.INT64:
+                # integer division truncates toward zero (pg int8div;
+                # jnp // floors, wrong for mixed signs)
+                li = l.values.astype(jnp.int64)
+                ri = r.values.astype(jnp.int64)
+                zero = ri == 0
+                _err.emit(
+                    _err.DIVISION_BY_ZERO,
+                    jnp.logical_and(
+                        zero,
+                        jnp.logical_not(
+                            jnp.logical_or(r.null_mask(), l.null_mask())
+                        ),
+                    ),
+                )
+                safe = jnp.where(zero, 1, ri)
+                q = jnp.abs(li) // jnp.abs(safe)
+                v = jnp.where(jnp.sign(li) == jnp.sign(safe), q, -q)
+                return Evaled(v, _or_nulls(nulls, zero), col)
             lv = _as_float(l)
             rv = _as_float(r)
             zero = rv == 0.0
@@ -757,7 +771,23 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
                     ),
                 ),
             )
-            v = jnp.where(zero, 0, l.values % jnp.where(zero, 1, r.values))
+            # pg mod truncates toward zero: result takes the DIVIDEND's
+            # sign (jnp % floors, giving the divisor's sign). Floats use
+            # fmod (already truncating); the integer path also covers
+            # DECIMAL (scaled-int mod IS decimal mod at that scale).
+            if col.ctype is ColumnType.FLOAT64:
+                lv, rv = _as_float(l), _as_float(r)
+                v = jnp.fmod(lv, jnp.where(zero, 1.0, rv))
+                return Evaled(
+                    jnp.where(zero, 0.0, v), _or_nulls(nulls, zero), col
+                )
+            li = l.values.astype(jnp.int64)
+            ri = jnp.where(zero, 1, r.values.astype(jnp.int64))
+            q = jnp.abs(li) // jnp.abs(ri)
+            tq = jnp.where(jnp.sign(li) == jnp.sign(ri), q, -q)
+            v = jnp.where(zero, 0, li - tq * ri)
+            if l.values.dtype != jnp.int64:
+                v = v.astype(l.values.dtype)
             return Evaled(v, _or_nulls(nulls, zero), col)
         if f == BinaryFunc.POWER:
             lv, rv = _as_float(l), _as_float(r)
@@ -817,8 +847,7 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
             fn = expr.func[len(STRING_FUNC_PREFIX):]
             key = _string_func_key(fn, expr.exprs[1:])
             e = eval_expr(expr.exprs[0], batch, time)
-            table = strings.trace_env()[key]
-            vals = table[jnp.clip(e.values, 0, table.shape[0] - 1)]
+            vals = strings.lookup(strings.trace_env()[key], e.values)
             return Evaled(vals, e.nulls, col)
         if expr.func == VariadicFunc.COALESCE:
             # pg evaluates COALESCE arguments in order until the first

@@ -16,9 +16,9 @@ jit inputs, so arrays grow with the dictionary without retracing until
 the tier changes. Inside the traced step, eval_expr reads the current
 env through a trace-scope contextvar.
 
-Ordering: codes are insertion-ordered, so comparisons map codes
-through the ``rank`` table (lexicographic rank per code) before
-comparing — making <, <=, ORDER-ish device logic correct for strings.
+Ordering: dictionary codes are order-preserving labels
+(repr/schema.py StringDictionary), so string comparisons, ORDER BY,
+MIN/MAX, and TopK all operate on codes directly — no rank table.
 """
 
 from __future__ import annotations
@@ -171,83 +171,93 @@ RESULT_KINDS = {
     "length": "int", "ascii": "int", "bit_length": "int",
     "octet_length": "int", "position": "int",
     "like": "bool", "ilike": "bool", "regex": "bool",
-    "rank": "int",
 }
 
 
 class _EnvCache:
-    """Host cache: (key, tier) -> np mapping array. Tables are
-    recomputed only for the dictionary's NEW suffix when it grows
-    within a tier, and re-padded when it crosses one."""
+    """Host cache: key -> (labels, values) np arrays, padded to a
+    power-of-two tier of the dictionary size. Codes are SPARSE
+    order-preserving labels (StringDictionary), so a table is a sorted
+    label array + parallel values; the device lookup is
+    searchsorted(labels, code) -> gather. Rebuilt when the dictionary
+    version moves (growth only appends pairs, but label order is not
+    insertion order, so the sorted arrays are rebuilt wholesale —
+    dictionary sizes are host-trivial)."""
 
     def __init__(self):
-        self._tables: dict[str, np.ndarray] = {}
-        self._filled: dict[str, int] = {}
+        self._tables: dict[str, tuple] = {}
+        self._version: dict[str, int] = {}
+        # per-key computed results: label -> value. _apply (the Python
+        # scalar kernel, possibly regex) runs ONCE per (key, string)
+        # ever; dictionary growth only computes the NEW strings and
+        # re-sorts arrays with numpy (streaming workloads stay
+        # O(new strings) Python work per step, not O(dict)).
+        self._done: dict[str, dict] = {}
 
-    def table(self, key: str) -> np.ndarray:
+    def table(self, key: str) -> tuple:
         parts = key.split("\x00")
         func, params = parts[0], tuple(parts[1:])
-        n = len(GLOBAL_DICT)
-        tier = capacity_tier(max(n, 1))
         kind = RESULT_KINDS[func]
         dtype = {
-            "str": np.int32, "int": np.int64, "bool": np.bool_
+            "str": np.int64, "int": np.int64, "bool": np.bool_
         }[kind]
-        tbl = self._tables.get(key)
-        filled = self._filled.get(key, 0)
-        if tbl is None or tbl.shape[0] < tier:
-            new = np.zeros(tier, dtype=dtype)
-            if tbl is not None:
-                new[: tbl.shape[0]] = tbl
-            tbl = new
-        if func == "rank":
-            if filled < n:  # ranks shift globally as entries arrive
-                order = sorted(
-                    range(n), key=lambda c: GLOBAL_DICT.decode(c)
-                )
-                tbl = np.zeros(tier, dtype=np.int64)
-                for r, c in enumerate(order):
-                    tbl[c] = r
-                filled = n
-        else:
-            for code in range(filled, n):
-                v = _apply(func, params, GLOBAL_DICT.decode(code))
-                if kind == "str":
-                    v = GLOBAL_DICT.encode(v)
-                tbl[code] = v
-            filled = n
-        # note: encoding RESULTS may grow the dictionary; results-of-
-        # results resolve next step (tables are rebuilt per step)
-        self._tables[key] = tbl
-        self._filled[key] = filled
-        return tbl
+        cached = self._tables.get(key)
+        if cached is not None and self._version.get(key) == (
+            GLOBAL_DICT.version
+        ):
+            return cached
+        done = self._done.setdefault(key, {})
+        pairs = GLOBAL_DICT.items_sorted()  # snapshot: results may
+        for code, s in pairs:               # grow the dict mid-loop
+            if code in done:
+                continue
+            v = _apply(func, params, s)
+            if kind == "str":
+                v = GLOBAL_DICT.encode(v)
+            done[code] = v
+        n = len(pairs)
+        tier = capacity_tier(max(n, 1))
+        labels = np.full(tier, GLOBAL_DICT.MAX_LABEL, dtype=np.int64)
+        labels[:n] = [c for c, _ in pairs]
+        values = np.zeros(tier, dtype=dtype)
+        values[:n] = [done[c] for c, _ in pairs]
+        self._tables[key] = (labels, values)
+        self._version[key] = GLOBAL_DICT.version
+        return self._tables[key]
 
 
 _CACHE = _EnvCache()
 
 
 def build_env(keys, depth: int = 1) -> dict:
-    """Mapping arrays for the given keys at the current dictionary
-    size (device-transferred by the caller as jit inputs).
+    """Mapping tables for the given keys at the current dictionary
+    state (device-transferred by the caller as jit inputs): each env
+    entry is a (sorted_labels, values) pair.
 
     ``depth`` is the maximum nesting depth of string calls in the
     dataflow's expressions (collect_keys reports it): a chained
     upper(trim(x)) needs the ``upper`` table to cover ``trim``'s RESULT
     strings, so tables are rebuilt depth times. A dictionary-size
     fixpoint would NOT terminate — generative functions (concat) grow
-    the dictionary on every pass when applied to their own outputs.
-
-    The ``rank`` table is built LAST in the final pass: every other
-    table's result encoding may grow the dictionary, and a rank table
-    built before that would give the new codes rank 0."""
-    all_keys = set(keys)
-    fn_keys = sorted(all_keys - {"rank"})
+    the dictionary on every pass when applied to their own outputs."""
+    fn_keys = sorted(set(keys))
     tables: dict = {}
     for _ in range(max(1, depth)):
         tables = {k: _CACHE.table(k) for k in fn_keys}
-    if "rank" in all_keys:
-        tables["rank"] = _CACHE.table("rank")
-    return {k: jnp.asarray(v) for k, v in tables.items()}
+    return {
+        k: (jnp.asarray(l), jnp.asarray(v))
+        for k, (l, v) in tables.items()
+    }
+
+
+def lookup(table: tuple, codes):
+    """Device-side table lookup: searchsorted over the sorted label
+    array, then gather. Valid codes always hit exactly (tables cover
+    the whole dictionary); padding rows gather garbage that downstream
+    validity masks drop."""
+    labels, values = table
+    idx = jnp.searchsorted(labels, codes)
+    return values[jnp.clip(idx, 0, values.shape[0] - 1)]
 
 
 # -- render-time key collection ----------------------------------------------
@@ -255,8 +265,7 @@ def build_env(keys, depth: int = 1) -> dict:
 
 def collect_keys(rel) -> tuple:
     """(keys, depth) for a MIR relation tree's expressions: the
-    'str:*' function keys (plus 'rank' when an ordering comparison, a
-    TopK ordering, or a MIN/MAX aggregate touches a STRING column) and
+    'str:*' function keys and
     the maximum string-call nesting depth (build_env pass count).
     Called by the render layer so each Dataflow's step only carries the
     tables it uses."""
@@ -290,20 +299,6 @@ def collect_keys(rel) -> tuple:
             fn = e.func[len(ms.STRING_FUNC_PREFIX):]
             keys.add(ms._string_func_key(fn, e.exprs[1:]))
             max_depth[0] = max(max_depth[0], str_depth(e))
-        if isinstance(e, ms.CallBinary) and e.func in (
-            ms.BinaryFunc.LT,
-            ms.BinaryFunc.LTE,
-            ms.BinaryFunc.GT,
-            ms.BinaryFunc.GTE,
-        ):
-            try:
-                if (
-                    e.left.typ(schema).ctype is ColumnType.STRING
-                    and e.right.typ(schema).ctype is ColumnType.STRING
-                ):
-                    keys.add("rank")
-            except Exception:
-                keys.add("rank")  # conservative on typing failure
         for f in getattr(e, "__dataclass_fields__", {}):
             v = getattr(e, f)
             if isinstance(v, ms.ScalarExpr):
@@ -333,16 +328,6 @@ def collect_keys(rel) -> tuple:
             sch = node.input.schema()
             for a in node.aggregates:
                 walk_scalar(a.expr, sch)
-                if a.func in (
-                    mir.AggregateFunc.MIN,
-                    mir.AggregateFunc.MAX,
-                ) and a.expr.typ(sch).ctype is ColumnType.STRING:
-                    keys.add("rank")
-        elif isinstance(node, mir.TopK):
-            sch = node.input.schema()
-            for idx, _desc, _nl in node.order_by:
-                if sch[idx].ctype is ColumnType.STRING:
-                    keys.add("rank")
         elif isinstance(node, mir.FlatMap):
             sch = node.input.schema()
             for f in getattr(node, "__dataclass_fields__", {}):

@@ -26,7 +26,12 @@ from dataclasses import dataclass
 
 import jax.numpy as jnp
 
-from ..arrangement.spine import Arrangement, insert, lookup_range
+from ..arrangement.spine import (
+    Arrangement,
+    Spine,
+    insert_tail,
+    lookup_range,
+)
 from ..expr.scalar import ColumnRef
 from ..ops.join import expand_ranges, null_key_diffs
 from ..ops.lanes import column_lanes, key_lanes
@@ -132,8 +137,9 @@ def _plan_pipelines(n_inputs: int, arities, equivalences):
 
 @dataclass
 class DeltaJoinOp:
-    """State: one Arrangement per (input, probe-key) pair (shared by all
-    pipelines). Output schema: concat of input schemas (MIR Join)."""
+    """State: one Spine (two-run amortized arrangement) per (input,
+    probe-key) pair (shared by all pipelines). Output schema: concat of
+    input schemas (MIR Join)."""
 
     input_schemas: tuple
     equivalences: tuple
@@ -161,20 +167,20 @@ class DeltaJoinOp:
             self.arr_schemas.append(Schema(cols))
         self.n_parts = len(self.arr_specs)
 
-    def init_state(self, capacity: int = 256) -> tuple:
+    def init_state(self, capacity: int = 256, tail_capacity: int = 1024) -> tuple:
         return tuple(
-            Arrangement.empty(sch, key, capacity)
+            Spine.empty(sch, key, capacity, tail_capacity)
             for (j, key), sch in zip(self.arr_specs, self.arr_schemas)
         )
 
-    def _probe(self, acc: Batch, arr: Arrangement, acc_key, out_time,
+    def _probe(self, acc: Batch, spine: Spine, acc_key, out_time,
                out_capacity: int):
-        """acc ⋈ arr on acc_key: returns (extended acc, overflow).
+        """acc ⋈ spine on acc_key: returns (extended acc, overflow).
 
         Probe lanes must match the arrangement's key-lane layout, whose
         key columns are normalized NON-nullable (null keys never join) —
         so encode value lanes only and zero the diff of null-key probe
-        rows instead of emitting a null lane."""
+        rows instead of emitting a null lane. Probes both spine runs."""
         probe_lanes = []
         diff = acc.diff
         for i in acc_key:
@@ -186,6 +192,17 @@ class DeltaJoinOp:
         if not probe_lanes:
             probe_lanes = [jnp.zeros(acc.capacity, dtype=jnp.uint64)]
         acc = acc.replace(diff=diff)
+        outs, ovfs = [], []
+        for arr in spine.runs():
+            out, ovf = self._probe_run(
+                acc, arr, probe_lanes, out_time, out_capacity
+            )
+            outs.append(out)
+            ovfs.append(ovf)
+        return concat_batches(outs), jnp.logical_or(*ovfs)
+
+    def _probe_run(self, acc: Batch, arr: Arrangement, probe_lanes,
+                   out_time, out_capacity: int):
         lo, hi = lookup_range(arr, probe_lanes)
         valid = jnp.logical_and(acc.valid_mask(), acc.diff != 0)
         probe_idx, match, out_valid, overflow = expand_ranges(
@@ -234,9 +251,7 @@ class DeltaJoinOp:
                 diff=null_key_diffs(deltas[j], key), schema=sch
             )
             d = route(d, key, ("ins", p))
-            new_state[p], st_ovf[p] = insert(
-                state[p], d, state[p].capacity
-            )
+            new_state[p], st_ovf[(p, "tail")] = insert_tail(state[p], d)
 
         probe_ovf = jnp.asarray(False)
         outs = []

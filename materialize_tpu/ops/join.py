@@ -25,7 +25,12 @@ from dataclasses import dataclass
 
 import jax.numpy as jnp
 
-from ..arrangement.spine import Arrangement, insert, lookup_range
+from ..arrangement.spine import (
+    Arrangement,
+    Spine,
+    insert_tail,
+    lookup_range,
+)
 from ..ops.lanes import key_lanes
 from ..ops.sort import concat_batches
 from ..repr.batch import Batch
@@ -65,9 +70,10 @@ def null_key_diffs(batch: Batch, key) -> jnp.ndarray:
 
 @dataclass
 class JoinOp:
-    """One binary linear-join stage. State: (left, right) arrangements
-    keyed by the join key columns. Output schema: left cols ++ right cols
-    (MIR Join concatenates inputs; relation.rs Join)."""
+    """One binary linear-join stage. State: (left, right) SPINES keyed by
+    the join key columns (two-run amortized arrangements — join state is
+    input-sized, the big-state case). Output schema: left cols ++ right
+    cols (MIR Join concatenates inputs; relation.rs Join)."""
 
     left_schema: Schema
     right_schema: Schema
@@ -104,13 +110,15 @@ class JoinOp:
         )
         self.n_parts = 2
 
-    def init_state(self, capacity: int = 256) -> tuple:
+    def init_state(self, capacity: int = 256, tail_capacity: int = 1024) -> tuple:
         return (
-            Arrangement.empty(
-                self.left_state_schema, self.left_key, capacity
+            Spine.empty(
+                self.left_state_schema, self.left_key, capacity,
+                tail_capacity,
             ),
-            Arrangement.empty(
-                self.right_state_schema, self.right_key, capacity
+            Spine.empty(
+                self.right_state_schema, self.right_key, capacity,
+                tail_capacity,
             ),
         )
 
@@ -122,16 +130,37 @@ class JoinOp:
 
     def _probe(
         self,
-        arr: Arrangement,
+        spine: Spine,
         delta: Batch,
         delta_key,
         delta_is_left: bool,
         out_time,
         out_capacity: int,
     ):
-        """delta ⋈ arr (matching rows expanded), output in out_schema
-        column order."""
+        """delta ⋈ spine (matching rows expanded), output in out_schema
+        column order. Probes both runs of the spine; a row value present
+        in both runs (with cancelling diffs) yields matches from both,
+        which downstream consolidation cancels — multiset semantics."""
         probe_lanes = key_lanes(delta, delta_key)
+        outs, ovfs = [], []
+        for arr in spine.runs():
+            out, ovf = self._probe_run(
+                arr, probe_lanes, delta, delta_is_left, out_time,
+                out_capacity,
+            )
+            outs.append(out)
+            ovfs.append(ovf)
+        return concat_batches(outs), jnp.logical_or(*ovfs)
+
+    def _probe_run(
+        self,
+        arr: Arrangement,
+        probe_lanes,
+        delta: Batch,
+        delta_is_left: bool,
+        out_time,
+        out_capacity: int,
+    ):
         lo, hi = lookup_range(arr, probe_lanes)
         valid = jnp.logical_and(delta.valid_mask(), delta.diff != 0)
         probe_idx, match, out_valid, overflow = expand_ranges(
@@ -182,9 +211,12 @@ class JoinOp:
         dl = self._clean(d_left, self.left_key, self.left_state_schema)
         dr = self._clean(d_right, self.right_key, self.right_state_schema)
 
+        # Hot-path insert touches only the tail run (O(tail), not
+        # O(state)); the host's scheduled compact_spine dispatch does
+        # the amortized base merge.
         overflow = {}
-        new_A, overflow[0] = insert(A, dl, A.capacity)
-        new_B, overflow[1] = insert(B, dr, B.capacity)
+        new_A, overflow[(0, "tail")] = insert_tail(A, dl)
+        new_B, overflow[(1, "tail")] = insert_tail(B, dr)
 
         # dA ⋈ B_old
         out1, ovf1 = self._probe(

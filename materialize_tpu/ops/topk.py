@@ -52,17 +52,9 @@ def order_lane_arrays(batch: Batch, order_by) -> list[jnp.ndarray]:
         col = batch.schema[col_idx]
         arr = batch.cols[col_idx]
         nulls = batch.nulls[col_idx]
-        if col.ctype is ColumnType.STRING:
-            # TopK state persists order lanes across steps and keys the
-            # arrangement on them; string ranks SHIFT as the dictionary
-            # grows, so rank-derived lanes would break retraction
-            # matching. ORDER BY text works at result finishing
-            # (host-side, coord _finish); device TopK over text awaits
-            # per-step lane recomputation.
-            raise NotImplementedError(
-                "TopK/LIMIT ordered by a text column is not supported "
-                "on device; ORDER BY text without LIMIT is fine"
-            )
+        # STRING included: dictionary codes are order-preserving labels
+        # (repr/schema.py StringDictionary), stable across steps, so
+        # TopK arrangements keyed on string lanes stay consistent.
         val_lanes = list(column_lanes(arr, col.ctype))
         if desc:
             val_lanes = [~l for l in val_lanes]

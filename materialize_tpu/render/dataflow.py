@@ -36,7 +36,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..arrangement.spine import Arrangement, arrange, insert
+from ..arrangement.spine import (
+    Arrangement,
+    Spine,
+    arrange,
+    compact_spine,
+    insert,
+    insert_tail,
+)
 from ..expr import relation as mir
 from ..expr.linear import MapFilterProject, apply_mfp
 from ..ops.consolidate import consolidate
@@ -580,14 +587,15 @@ def _build_letrec(expr: mir.LetRec, ctx: _RenderContext):
 
         def canon_states(states_l):
             """Null-mask presence must be loop-invariant (pytree aux of
-            the while_loop carry): canonicalize every arrangement batch."""
+            the while_loop carry): canonicalize every arrangement (or
+            spine-run) batch."""
             out = []
             for s in states_l:
                 if isinstance(s, tuple):
                     out.append(
                         tuple(
-                            Arrangement(
-                                a.batch.canonicalize_nulls(), a.key
+                            a.map_batches(
+                                lambda b: b.canonicalize_nulls()
                             )
                             for a in s
                         )
@@ -723,7 +731,14 @@ class _DataflowBase:
         from ..repr.schema import ERR_SCHEMA
 
         out_key = tuple(range(self.out_schema.arity))
-        self.output = Arrangement.empty(self.out_schema, out_key, capacity)
+        # The output index is a two-run Spine: per-step inserts touch
+        # only the tail; scheduled compactions fold the tail into the
+        # base (so an index over a 2^20-row collection costs O(tail)
+        # per step, not O(state)).
+        self.output = Spine.empty(
+            self.out_schema, out_key, capacity,
+            tail_capacity=self._ctx.out_delta_cap,
+        )
         # The err collection: scalar-evaluation errors maintained next
         # to the data output (ok/err pair, render.rs:12-101). Reads
         # consult it first; deleting the offending row retracts the
@@ -740,6 +755,28 @@ class _DataflowBase:
         self._defer_ck = None
         self._defer_log: list = []
         self._defer_flags: list = []
+        self._defer_cflags: list = []
+        # Spine-compaction schedule: every K steps the host dispatches
+        # one compact program that merges every spine's tail into its
+        # base (the amortized O(state) merge; differential's spine-merge
+        # exert budget). Deterministic — driven by a host counter that
+        # is part of the rollback checkpoint, so overflow replays
+        # reproduce the same schedule.
+        self._compact_every = 8
+        self._steps_since_compact = 0
+        self._compact_jit = None
+        self._covf_keys = self._compact_keys()
+
+    def _compact_keys(self) -> list:
+        """Overflow-flag keys of the compact program (base-run growth),
+        in the deterministic order the program packs them."""
+        keys = []
+        for slot, parts in enumerate(self.states):
+            for p, s in enumerate(parts):
+                if isinstance(s, Spine):
+                    keys.append(("state", slot, (p, "base")))
+        keys.append(("out", "base"))
+        return keys
 
     def _pack_flags(self, ovf: dict) -> jnp.ndarray:
         """Deterministically order overflow flags into one tiny array.
@@ -758,10 +795,14 @@ class _DataflowBase:
         if key[0] == "state":
             _, slot, part = key
             parts = list(self.states[slot])
-            parts[part] = self._grow_arrangement(parts[part])
+            if isinstance(part, tuple):  # spine sub-run: (part, which)
+                p, which = part
+                parts[p] = self._grow_spine(parts[p], which)
+            else:
+                parts[part] = self._grow_arrangement(parts[part])
             self.states[slot] = tuple(parts)
         elif key[0] == "out":
-            self.output = self._grow_arrangement(self.output)
+            self.output = self._grow_spine(self.output, key[1])
         elif key[0] == "join":
             self._ctx.join_caps[key[1]] *= 2
             self._remake_jit()
@@ -778,6 +819,17 @@ class _DataflowBase:
             self.err_output = self._grow_arrangement(self.err_output)
         else:
             raise AssertionError(f"unknown overflow key {key}")
+
+    def _grow_arrangement(self, arr: Arrangement) -> Arrangement:
+        return arr.map_batches(self._grow_batch)
+
+    def _grow_spine(self, spine: Spine, which: str) -> Spine:
+        if which == "base":
+            return Spine(
+                self._grow_batch(spine.base), spine.tail, spine.key
+            )
+        assert which == "tail", which
+        return Spine(spine.base, self._grow_batch(spine.tail), spine.key)
 
     def step(self, inputs: dict) -> Batch:
         """Feed one micro-batch of updates per source; returns the output
@@ -852,6 +904,7 @@ class _DataflowBase:
             self.err_output,
             self.time,
             self._time_dev,
+            self._steps_since_compact,
         )
 
     def _restore(self, ck):
@@ -861,16 +914,53 @@ class _DataflowBase:
             self.err_output,
             self.time,
             self._time_dev,
+            self._steps_since_compact,
         ) = ck
 
-    def _dispatch_span(self, packed: list, env) -> tuple[list, list]:
-        """Asynchronously dispatch one step per packed input. ZERO host
-        transfers: time rides as a device scalar (created once per
-        dataflow), overflow flags stay on device for the caller to
-        check. Returns (deltas, per-step flag arrays)."""
+    def _dispatch_compact(self):
+        """Dispatch one spine-compaction program (merge every spine's
+        tail into its base). Async like steps; returns its packed
+        base-overflow flags (key order: self._covf_keys)."""
+        if self._compact_jit is None:
+            self._compact_jit = self._make_compact_jit()
+        new_states, new_output, cfl = self._compact_jit(
+            tuple(self.states), self.output
+        )
+        self.states = list(new_states)
+        self.output = new_output
+        return cfl
+
+    def _compact_core_single(self, states, output):
+        """Trace body of the compact program (single-device layout).
+        Walks the static state layout; only Spine parts are touched."""
+        flags = {}
+        new_states = []
+        for slot, parts in enumerate(states):
+            ps = list(parts)
+            for p, s in enumerate(ps):
+                if isinstance(s, Spine):
+                    ps[p], ovf = compact_spine(s)
+                    flags[("state", slot, (p, "base"))] = ovf
+            new_states.append(tuple(ps))
+        new_out, oovf = compact_spine(output)
+        flags[("out", "base")] = oovf
+        packed = jnp.stack(
+            [
+                jnp.asarray(flags[k]).astype(jnp.bool_).reshape(())
+                for k in self._covf_keys
+            ]
+        )
+        return tuple(new_states), new_out, packed
+
+    def _dispatch_span(self, packed: list, env) -> tuple[list, list, list]:
+        """Asynchronously dispatch one step per packed input, plus the
+        scheduled spine compactions. ZERO host transfers: time rides as
+        a device scalar (created once per dataflow), overflow flags stay
+        on device for the caller to check. Returns (deltas, per-step
+        flag arrays, per-compaction flag arrays)."""
         if self._time_dev is None:
             self._time_dev = jnp.asarray(self.time, dtype=jnp.uint64)
-        deltas, flags = [], []
+        deltas, flags, cflags = [], [], []
         for p in packed:
             args = (
                 tuple(self.states),
@@ -894,20 +984,67 @@ class _DataflowBase:
             self._time += 1  # direct: keep the device carry live
             deltas.append(out)
             flags.append(fl)
-        return deltas, flags
+            self._steps_since_compact += 1
+            if self._steps_since_compact >= self._compact_every:
+                cflags.append(self._dispatch_compact())
+                self._steps_since_compact = 0
+        return deltas, flags, cflags
 
-    def _read_flags(self, flags: list) -> np.ndarray:
-        """One d2h readback of the packed overflow flags for a span.
+    def _read_flags(self, flags: list, keys: list) -> np.ndarray:
+        """One d2h readback of packed overflow flags for a span.
         NOTE: through the remote-TPU tunnel, the FIRST d2h readback in a
         process permanently switches dispatch from pipelined-async to
         synchronous round-trips (~10 ms/dispatch; measured, see
         PERF_NOTES.md). Latency-critical paths defer this via
         run_steps(defer_check=True) + check_flags()."""
-        if flags and self._ovf_keys:
+        if flags and keys:
             fh = np.asarray(jnp.stack(flags))  # [K, nkeys] or [K, nkeys, P]
-            per_key = fh.reshape(fh.shape[0], len(self._ovf_keys), -1)
+            per_key = fh.reshape(fh.shape[0], len(keys), -1)
             return per_key.any(axis=(0, 2))
-        return np.zeros(0, dtype=bool)
+        return np.zeros(len(keys) if keys else 0, dtype=bool)
+
+    def _overflowed_keys(self, flags: list, cflags: list) -> list:
+        """Read both flag groups (steps + compactions); returns the list
+        of overflowed tier keys."""
+        out = []
+        for i in np.nonzero(self._read_flags(flags, self._ovf_keys))[0]:
+            out.append(self._ovf_keys[i])
+        for i in np.nonzero(self._read_flags(cflags, self._covf_keys))[0]:
+            out.append(self._covf_keys[i])
+        return out
+
+    def _compact_now(self) -> None:
+        """Synchronously compact every spine (tail -> base): peeks and
+        snapshots read the base run as THE consolidated state. Grows
+        base tiers on overflow and retries."""
+        while True:
+            ck = self._checkpoint()
+            cfl = self._dispatch_compact()
+            self._steps_since_compact = 0
+            over = self._read_flags([cfl], self._covf_keys)
+            if not over.any():
+                return
+            self._restore(ck)
+            for i in np.nonzero(over)[0]:
+                self._grow_for(self._covf_keys[i])
+
+    def output_batch(self) -> Batch:
+        """Consolidated single-run view of the maintained output index
+        (device-resident). Forces a spine compaction first — peeks are
+        off the hot path (compute_state.rs:744 handle_peek reads a
+        trace cursor; here the compacted base run IS the cursor)."""
+        self.check_flags()
+        self._compact_now()
+        return self.output.base
+
+    def output_records(self) -> int:
+        """Approximate maintained row count (base + tail counts; may
+        overcount rows whose diffs cancel across runs until the next
+        compaction). Introspection only — one small d2h read."""
+        return int(
+            np.asarray(self.output.base.count).sum()
+            + np.asarray(self.output.tail.count).sum()
+        )
 
     def run_steps(self, inputs_list: list, defer_check: bool = False) -> list:
         """Feed several micro-batches with deferred overflow handling:
@@ -940,19 +1077,20 @@ class _DataflowBase:
         if defer_check:
             if self._defer_ck is None:
                 self._defer_ck = self._checkpoint()
-            deltas, flags = self._dispatch_span(packed, env)
+            deltas, flags, cflags = self._dispatch_span(packed, env)
             self._defer_log.append((packed, env))
             self._defer_flags.extend(flags)
+            self._defer_cflags.extend(cflags)
             return deltas
         self.check_flags()
         while True:
             ck = self._checkpoint()
-            deltas, flags = self._dispatch_span(packed, env)
-            overflowed = self._read_flags(flags)
-            if overflowed.any():
+            deltas, flags, cflags = self._dispatch_span(packed, env)
+            over = self._overflowed_keys(flags, cflags)
+            if over:
                 self._restore(ck)
-                for i in np.nonzero(overflowed)[0]:
-                    self._grow_for(self._ovf_keys[i])
+                for k in over:
+                    self._grow_for(k)
                 continue
             return deltas
 
@@ -963,19 +1101,20 @@ class _DataflowBase:
         and replays the logged spans synchronously. Returns whether any
         overflow occurred (callers timing the deferred spans use this to
         invalidate their measurement)."""
-        if not self._defer_flags:
+        if not self._defer_flags and not self._defer_cflags:
             self._defer_ck = None
             self._defer_log = []
             return False
-        overflowed = self._read_flags(self._defer_flags)
+        over = self._overflowed_keys(self._defer_flags, self._defer_cflags)
         log = self._defer_log
         ck = self._defer_ck
-        self._defer_log, self._defer_flags, self._defer_ck = [], [], None
-        if not overflowed.any():
+        self._defer_log, self._defer_flags, self._defer_cflags = [], [], []
+        self._defer_ck = None
+        if not over:
             return False
         self._restore(ck)
-        for i in np.nonzero(overflowed)[0]:
-            self._grow_for(self._ovf_keys[i])
+        for k in over:
+            self._grow_for(k)
         # The deltas handed out during the deferred window were computed
         # against truncated state; the replay's corrected deltas are
         # published for callers that forward deltas to sinks.
@@ -983,14 +1122,14 @@ class _DataflowBase:
         for packed, env in log:
             while True:
                 ck2 = self._checkpoint()
-                deltas, flags = self._dispatch_span(packed, env)
-                ovf = self._read_flags(flags)
-                if not ovf.any():
+                deltas, flags, cflags = self._dispatch_span(packed, env)
+                ovf = self._overflowed_keys(flags, cflags)
+                if not ovf:
                     self.replayed_deltas.extend(deltas)
                     break
                 self._restore(ck2)
-                for i in np.nonzero(ovf)[0]:
-                    self._grow_for(self._ovf_keys[i])
+                for k in ovf:
+                    self._grow_for(k)
         return True
 
 
@@ -1036,10 +1175,11 @@ class Dataflow(_DataflowBase):
                 lambda s, o, eo, i, t: self._step_core(s, o, eo, i, t)
             )
 
-    def _grow_arrangement(self, arr: Arrangement) -> Arrangement:
-        return Arrangement(
-            arr.batch.with_capacity(arr.batch.capacity * 2), arr.key
-        )
+    def _grow_batch(self, b: Batch) -> Batch:
+        return b.with_capacity(b.capacity * 2)
+
+    def _make_compact_jit(self):
+        return jax.jit(self._compact_core_single)
 
     def _pack_inputs(self, inputs: dict) -> dict:
         return inputs
@@ -1066,12 +1206,10 @@ class Dataflow(_DataflowBase):
         # union-produced +/- pairs at the same time cancel.
         out = consolidate(out, include_time=True)
         out, shrink_ovf = shrink(out, self._ctx.out_delta_cap)
-        new_output, out_ovf = insert(
-            output, out, out_capacity=output.capacity
-        )
+        new_output, out_ovf = insert_tail(output, out)
         ovf = dict(ovf)
         ovf[("outd",)] = shrink_ovf
-        ovf[("out",)] = out_ovf
+        ovf[("out", "tail")] = out_ovf
         # The err collection delta (scalar-eval errors published by
         # apply_mfp sites during the _run trace above).
         new_err = self._apply_err_delta(err_output, err_parts, ovf)
@@ -1088,8 +1226,7 @@ class Dataflow(_DataflowBase):
 
     def peek(self) -> list[tuple]:
         """Read the full maintained result (SELECT * FROM mv)."""
-        self.check_flags()
-        return self.output.batch.to_rows()
+        return self.output_batch().to_rows()
 
     def peek_errors(self) -> list[tuple]:
         """The maintained err collection: [(err_code, count)] with
@@ -1173,8 +1310,12 @@ class ShardedDataflow(_DataflowBase):
         """Each worker starts with empty shards of every state part."""
         return tuple(self._replicate_empty_one(a) for a in parts)
 
-    def _replicate_empty_one(self, arr: Arrangement) -> Arrangement:
-        """Each worker starts with an empty shard of this arrangement."""
+    def _replicate_empty_one(self, obj):
+        """Each worker starts with an empty shard of this arrangement
+        (or of each run of a spine)."""
+        return obj.map_batches(self._rep_batch)
+
+    def _rep_batch(self, b: Batch) -> Batch:
         P_ = self.num_shards
 
         def rep(a):
@@ -1184,8 +1325,7 @@ class ShardedDataflow(_DataflowBase):
                 np.zeros(P_ * a.shape[0], dtype=a.dtype), self._sharding
             )
 
-        b = arr.batch
-        gb = Batch(
+        return Batch(
             cols=tuple(rep(c) for c in b.cols),
             nulls=tuple(rep(n) for n in b.nulls),
             time=rep(b.time),
@@ -1195,12 +1335,10 @@ class ShardedDataflow(_DataflowBase):
             ),
             schema=b.schema,
         )
-        return Arrangement(gb, arr.key)
 
-    def _grow_arrangement(self, arr: Arrangement) -> Arrangement:
+    def _grow_batch(self, b: Batch) -> Batch:
         """Double every shard's capacity ([P, cap] -> [P, 2cap])."""
         P_ = self.num_shards
-        b = arr.batch
         cap = b.capacity // P_
 
         def grow(a):
@@ -1213,7 +1351,7 @@ class ShardedDataflow(_DataflowBase):
                 out.reshape(P_ * 2 * cap), self._sharding
             )
 
-        gb = Batch(
+        return Batch(
             cols=tuple(grow(c) for c in b.cols),
             nulls=tuple(grow(n) for n in b.nulls),
             time=grow(b.time),
@@ -1221,28 +1359,30 @@ class ShardedDataflow(_DataflowBase):
             count=b.count,
             schema=b.schema,
         )
-        return Arrangement(gb, arr.key)
 
     # -- the SPMD step ------------------------------------------------------
+    @staticmethod
+    def _scalar_counts(s: tuple) -> tuple:
+        return tuple(
+            o.map_batches(
+                lambda b: b.replace(count=b.count.reshape(()))
+            )
+            for o in s
+        )
+
+    @staticmethod
+    def _vec_counts(s: tuple) -> tuple:
+        return tuple(
+            o.map_batches(
+                lambda b: b.replace(count=b.count.reshape((1,)))
+            )
+            for o in s
+        )
+
     def _remake_jit(self):
         axis = self.axis_name
-
-        def scalar_counts(s):
-            return tuple(
-                Arrangement(
-                    a.batch.replace(count=a.batch.count.reshape(())), a.key
-                )
-                for a in s
-            )
-
-        def vec_counts(s):
-            return tuple(
-                Arrangement(
-                    a.batch.replace(count=a.batch.count.reshape((1,))),
-                    a.key,
-                )
-                for a in s
-            )
+        scalar_counts = self._scalar_counts
+        vec_counts = self._vec_counts
 
         def body(states, output, err_output, inputs, time):
             from ..expr import errors as _errors
@@ -1254,12 +1394,10 @@ class ShardedDataflow(_DataflowBase):
                 new_states[k] = v
             out = consolidate(out, include_time=True)
             out, shrink_ovf = shrink(out, self._ctx.out_delta_cap)
-            new_output, out_ovf = insert(
-                output, out, out_capacity=output.capacity
-            )
+            new_output, out_ovf = insert_tail(output, out)
             ovf = dict(ovf)
             ovf[("outd",)] = shrink_ovf
-            ovf[("out",)] = out_ovf
+            ovf[("out", "tail")] = out_ovf
             # Each worker maintains its own err shard (errors stay
             # where computed; peek_errors gathers).
             new_err = self._apply_err_delta(err_output, err_parts, ovf)
@@ -1320,6 +1458,39 @@ class ShardedDataflow(_DataflowBase):
                 )(states, output, err_output, inputs, time)
 
         self._step_jit = jax.jit(step)
+
+    def _make_compact_jit(self):
+        axis = self.axis_name
+        scalar_counts = self._scalar_counts
+        vec_counts = self._vec_counts
+
+        def per_worker(states, output):
+            states = [scalar_counts(s) for s in states]
+            (output,) = scalar_counts((output,))
+            new_states, new_out, fl = self._compact_core_single(
+                states, output
+            )
+            new_states = tuple(vec_counts(s) for s in new_states)
+            (new_out,) = vec_counts((new_out,))
+            fl = (jax.lax.psum(fl.astype(jnp.int32), axis) > 0).reshape(
+                -1, 1
+            )
+            return new_states, new_out, fl
+
+        def compact(states, output):
+            return jax.shard_map(
+                per_worker,
+                mesh=self.mesh,
+                in_specs=(P(self.axis_name), P(self.axis_name)),
+                out_specs=(
+                    P(self.axis_name),
+                    P(self.axis_name),
+                    P(None, self.axis_name),
+                ),
+                check_vma=False,
+            )(states, output)
+
+        return jax.jit(compact)
 
     def _pack_inputs(self, inputs: dict) -> dict:
         packed = {}
@@ -1400,8 +1571,7 @@ class ShardedDataflow(_DataflowBase):
         """Gather and combine every worker's output-arrangement shard.
         Different workers may hold the same row value (outputs stay where
         they were computed), so diffs are summed host-side."""
-        self.check_flags()
-        rows = self._gather_batch(self.output.batch).to_rows()
+        rows = self._gather_batch(self.output_batch()).to_rows()
         acc: dict = {}
         for r in rows:
             key = r[:-2]  # value columns only: shards may hold the same

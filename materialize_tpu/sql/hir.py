@@ -298,6 +298,10 @@ class HLetRec(HirRelation):
 class ScopeItem:
     table: Optional[str]  # alias the column is reachable under
     name: str
+    # JOIN ... USING merges the shared column: the non-preferred side's
+    # copy stays addressable by qualified name but is skipped by
+    # unqualified lookup and bare `*` (pg join-USING scope semantics).
+    hidden: bool = False
 
 
 @dataclass
@@ -318,6 +322,9 @@ class Scope:
             hits = [
                 i for i, it in enumerate(self.items) if it.name == parts[0]
             ]
+            visible = [i for i in hits if not self.items[i].hidden]
+            if visible:
+                hits = visible
         elif len(parts) == 2:
             hits = [
                 i

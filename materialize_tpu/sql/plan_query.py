@@ -348,6 +348,8 @@ class QueryPlanner:
                 on: list = []
                 if jc.using:
                     larity = len(s.items)
+                    from .hir import ScopeItem as _SI
+
                     for name in jc.using:
                         li = s.resolve((name,))
                         ri = js.resolve((name,))
@@ -357,6 +359,24 @@ class QueryPlanner:
                                 HColumn(li),
                                 HColumn(larity + ri),
                             )
+                        )
+                        # Merge the shared column: hide the copy whose
+                        # side can be NULL on unmatched rows, so the
+                        # surviving unqualified column carries the
+                        # merged value (pg USING semantics). FULL
+                        # would need a COALESCE column — refuse rather
+                        # than return wrong NULLs.
+                        if jc.kind == "full":
+                            raise PlanError(
+                                "FULL JOIN ... USING is not supported; "
+                                "use ON with explicit COALESCE"
+                            )
+                        hide = (
+                            li if jc.kind == "right" else larity + ri
+                        )
+                        it = combined.items[hide]
+                        combined.items[hide] = _SI(
+                            it.table, it.name, hidden=True
                         )
                 elif jc.on is not None:
                     on = self._conjuncts(jc.on, combined)
@@ -394,6 +414,8 @@ class QueryPlanner:
                 for i, sc in enumerate(scope.items):
                     if it.expr.qualifier and sc.table != it.expr.qualifier:
                         continue
+                    if not it.expr.qualifier and sc.hidden:
+                        continue  # USING-merged duplicate
                     items.append((ast.Ident((sc.table, sc.name)), sc.name))
             else:
                 items.append((it.expr, it.alias or _default_name(it.expr)))
@@ -500,14 +522,8 @@ class QueryPlanner:
                 aggs.append(HAggregate(func, inner, dist, out))
                 return ("plain", [len(aggs) - 1])
             if name in ("min", "max"):
-                if ityp.ctype is ColumnType.STRING:
-                    # hierarchical reduce state holds codes across steps
-                    # and the dictionary's rank shifts as it grows;
-                    # defer until the reduce kernels order via the rank
-                    # side-table per step
-                    raise PlanError(
-                        f"{name} over text is not yet supported"
-                    )
+                # STRING included: order-preserving dictionary codes
+                # make min/max over text a plain hierarchical reduce.
                 func = (
                     AggregateFunc.MIN if name == "min" else AggregateFunc.MAX
                 )
@@ -574,6 +590,16 @@ class QueryPlanner:
                 if kind == "plain":
                     return cols_[0]
                 if kind == "avg":
+                    # avg(int) divides as double (pg returns numeric;
+                    # `/` on two ints is INTEGER division since the
+                    # int8div fix). Decimal sums keep decimal division.
+                    s_col = aggs[idxs[0]].out
+                    if s_col.ctype in (
+                        ColumnType.INT32, ColumnType.INT64
+                    ):
+                        return ast.BinaryOp(
+                            "/", ast.Cast(cols_[0], "double"), cols_[1]
+                        )
                     return ast.BinaryOp("/", cols_[0], cols_[1])
                 # variance family: E[x^2] and E[x]^2 from (sum, sum_sq,
                 # count); sample variants divide by (count - 1), whose

@@ -209,10 +209,10 @@ class TpchGenerator:
             receiptdate <= today,
             self._flag_codes[self._draw(0, 2, sd, u, li, 9)],
             self._flag_codes[2],
-        ).astype(np.int32)
+        ).astype(np.int64)
         linestatus = np.where(
             shipdate > today, self._status_codes[1], self._status_codes[0]
-        ).astype(np.int32)
+        ).astype(np.int64)
         cols = [
             okeys,
             partkey,
@@ -236,13 +236,13 @@ class TpchGenerator:
         custkey = self._draw(1, self.n_customer + 1, sd, u, 21)
         status = self._status_codes[
             self._draw(0, 2, sd, u, 22)
-        ].astype(np.int32)
+        ].astype(np.int64)
         totalprice = self._draw(1_000_00, 500_000_00, sd, u, 23)
         orderdate = _EPOCH_1992 + (
             (orderkeys * 2654435761) % (_DATE_RANGE - 151)
         ).astype(np.int64)
         prio = self._prio_codes[self._draw(0, 5, sd, u, 24)].astype(
-            np.int32
+            np.int64
         )
         return [
             orderkeys,

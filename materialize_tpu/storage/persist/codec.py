@@ -94,11 +94,16 @@ def encode_part(
         local_strings = None
         if c.ctype is ColumnType.STRING:
             # Remap process-global codes to a local dense dictionary so
-            # the part is self-contained.
-            codes = np.asarray(a, dtype=np.int32)
+            # the part is self-contained. NULL rows carry placeholder
+            # codes that are not dictionary labels — normalize them to
+            # a real label first (their value is masked by the null
+            # column on decode).
+            codes = np.asarray(a, dtype=np.int64).copy()
+            if nl is not None:
+                codes[np.asarray(nl, bool)] = GLOBAL_DICT.encode("")
             uniq, inv = np.unique(codes, return_inverse=True)
             local_strings = [GLOBAL_DICT.decode(u) for u in uniq]
-            a = inv.astype(np.int32)
+            a = inv.astype(np.int64)
         buf, enc = _enc_buffer(a)
         buffers.append(buf)
         has_nulls = nl is not None
@@ -173,7 +178,7 @@ def decode_part(data: bytes):
             a = (
                 remap[a]
                 if len(remap)
-                else np.zeros(n, np.int32)
+                else np.zeros(n, np.int64)
             )
         cols.append(a)
         if m["has_nulls"]:

@@ -167,11 +167,26 @@ class IndexSource:
         self.publisher = publisher
         self.schema = schema
         self.reader = IndexSource._Reader(self)
-        # Base = the publisher's CURRENT arrangement (device-resident;
-        # gathered across shards for SPMD publishers). No source replay.
-        self.base = _host_updates(publisher.result_batch())
+        # Same-process single-device publishers share arrangements
+        # DEVICE-RESIDENT: the base snapshot is the publisher's output
+        # spine (compacted in HBM) and per-step deltas are handed over
+        # as the very device batches the publisher's step produced —
+        # zero host round-trips on the sharing path (round-2 weak #2;
+        # the reference's TraceManager shares traces in memory, not
+        # through a serialization hop). SPMD publishers gather across
+        # workers, so they keep the host path.
+        from ...render.dataflow import Dataflow as _SingleDevice
+
+        self._device = type(publisher.df) is _SingleDevice
+        self.host_transfers = 0  # observability for tests
+        if self._device:
+            self.base_batch = publisher.df.output_batch()
+        else:
+            self.host_transfers += 1
+            self.base = _host_updates(publisher.result_batch())
         self.base_upper = publisher.upper
-        self._pending: list = []  # (t, (cols, nulls, time, diff))
+        # device path: (t, Batch); host path: (t, host update arrays)
+        self._pending: list = []
         self.frontier: int | None = None
         publisher._subscribers.append(self)
 
@@ -212,6 +227,18 @@ class IndexSource:
         diff = np.concatenate([p[3] for p in parts])
         return cols, nulls, time, diff
 
+    @staticmethod
+    def _forward_times(b: Batch, t: int) -> Batch:
+        """Forward every row's time to ``t`` (logical compaction to the
+        snapshot/chunk timestamp) — a device-side constant fill; padding
+        rows are masked by count downstream."""
+        import jax.numpy as jnp
+
+        return b.replace(
+            time=jnp.full(b.capacity, t, dtype=jnp.uint64),
+            schema=b.schema,
+        )
+
     def snapshot(self, as_of: int) -> "tuple[Batch, int]":
         if as_of < self.base_upper - 1:
             raise ValueError(
@@ -219,9 +246,18 @@ class IndexSource:
                 f"arrangement is at {self.base_upper - 1} (no "
                 "multiversion arrangements)"
             )
+        self.frontier = as_of + 1
+        if self._device:
+            from ...ops.sort import concat_batches
+
+            parts = [self.base_batch] + self._take_until(as_of + 1)
+            b = concat_batches(parts) if len(parts) > 1 else parts[0]
+            return (
+                self._forward_times(b, as_of).replace(schema=self.schema),
+                as_of,
+            )
         parts = [self.base] + self._take_until(as_of + 1)
         cols, nulls, time, diff = self._concat(parts)
-        self.frontier = as_of + 1
         return (
             updates_to_batch(
                 self.schema, cols, nulls, time, diff, as_of
@@ -235,6 +271,16 @@ class IndexSource:
     def fetch_to(self, target: int) -> Batch:
         assert self.frontier is not None and target > self.frontier - 1
         parts = self._take_until(target)
+        self.frontier = target
+        if self._device:
+            from ...ops.sort import concat_batches
+
+            if not parts:
+                return Batch.empty(self.schema, 256)
+            b = concat_batches(parts) if len(parts) > 1 else parts[0]
+            return self._forward_times(b, target - 1).replace(
+                schema=self.schema
+            )
         got = self._concat(parts)
         if got is None:
             sch = self.schema
@@ -246,7 +292,6 @@ class IndexSource:
                 np.zeros(0, np.int64),
             )
         cols, nulls, time, diff = got
-        self.frontier = target
         return updates_to_batch(
             self.schema, cols, nulls, time, diff, target - 1
         )
@@ -406,7 +451,7 @@ class MaintainedView:
     def result_batch(self) -> Batch:
         """The maintained output arrangement as a HOST-readable batch
         (SPMD dataflows gather their per-worker shards first)."""
-        return self.df.gather_delta(self.df.output.batch)
+        return self.df.gather_delta(self.df.output_batch())
 
     def _append_correction(self, out_upper: int, as_of: int) -> None:
         """One chunk [out_upper, as_of+1) bringing the durable sink to
@@ -577,12 +622,20 @@ class MaintainedView:
     def _publish(self, t: int, out: Batch) -> None:
         """Push this step's output delta to index-import subscribers
         (TraceManager sharing: the subscriber's dataflow sees exactly
-        the arrangement's change stream)."""
+        the arrangement's change stream). Device-path subscribers get
+        the step's device batch itself (no host hop); host-path
+        subscribers (SPMD publishers) get host arrays."""
         if not self._subscribers:
             return
-        update = _host_updates(out)
+        update = None
         for sub in self._subscribers:
-            sub._push(t, update)
+            if getattr(sub, "_device", False):
+                sub._push(t, out)
+            else:
+                if update is None:
+                    sub.host_transfers += 1
+                    update = _host_updates(out)
+                sub._push(t, update)
 
     def run_until(self, frontier: int, timeout: float = 30.0) -> None:
         """Advance until the output upper reaches ``frontier``."""

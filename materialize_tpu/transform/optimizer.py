@@ -277,22 +277,234 @@ def predicate_pushdown(expr: mir.RelationExpr) -> mir.RelationExpr:
     return _bottom_up(expr, rw)
 
 
+def _fold_scalar(e: ms.ScalarExpr) -> ms.ScalarExpr:
+    """Evaluate literal-only scalar subtrees host-side (FoldConstants'
+    scalar interpreter, transform/src/fold_constants.rs). Conservative:
+    only operators with obvious host semantics fold; everything else is
+    rebuilt with folded children."""
+    if isinstance(e, ms.CallUnary):
+        x = _fold_scalar(e.expr)
+        if isinstance(x, ms.Literal):
+            if x.value is None:
+                if e.func == ms.UnaryFunc.IS_NULL:
+                    return ms.Literal(True, ColumnType.BOOL)
+                if e.func in (ms.UnaryFunc.NOT, ms.UnaryFunc.NEG):
+                    return ms.Literal(None, x.ctype, x.scale)
+            elif e.func == ms.UnaryFunc.NOT and isinstance(x.value, bool):
+                return ms.Literal(not x.value, ColumnType.BOOL)
+            elif e.func == ms.UnaryFunc.IS_NULL:
+                return ms.Literal(False, ColumnType.BOOL)
+            elif e.func == ms.UnaryFunc.NEG and isinstance(
+                x.value, (int, float)
+            ):
+                return ms.Literal(-x.value, x.ctype, x.scale)
+        return ms.CallUnary(e.func, x)
+    if isinstance(e, ms.CallBinary):
+        l, r = _fold_scalar(e.left), _fold_scalar(e.right)
+        if isinstance(l, ms.Literal) and isinstance(r, ms.Literal):
+            lv, rv = l.value, r.value
+            f = e.func
+            cmp = {
+                ms.BinaryFunc.EQ: lambda a, b: a == b,
+                ms.BinaryFunc.NEQ: lambda a, b: a != b,
+                ms.BinaryFunc.LT: lambda a, b: a < b,
+                ms.BinaryFunc.LTE: lambda a, b: a <= b,
+                ms.BinaryFunc.GT: lambda a, b: a > b,
+                ms.BinaryFunc.GTE: lambda a, b: a >= b,
+            }
+            if f in cmp:
+                if lv is None or rv is None:
+                    return ms.Literal(None, ColumnType.BOOL)
+                if l.scale == r.scale and not isinstance(lv, str):
+                    return ms.Literal(
+                        bool(cmp[f](lv, rv)), ColumnType.BOOL
+                    )
+            arith = {
+                ms.BinaryFunc.ADD: lambda a, b: a + b,
+                ms.BinaryFunc.SUB: lambda a, b: a - b,
+                ms.BinaryFunc.MUL: lambda a, b: a * b,
+            }
+            # Fold only when the result type is unambiguous (equal
+            # operand ctypes): typing the fold by one side's ctype
+            # would silently change the expression's schema when
+            # operand types differ (mixed int/float, int32/int64).
+            if (
+                f in arith
+                and l.ctype == r.ctype
+                and isinstance(lv, int)
+                and not isinstance(lv, bool)
+                and isinstance(rv, int)
+                and not isinstance(rv, bool)
+                and l.scale == 0
+                and r.scale == 0
+            ):
+                return ms.Literal(arith[f](lv, rv), l.ctype)
+            if (
+                f in arith
+                and l.ctype == r.ctype
+                and l.scale == r.scale
+                and (lv is None or rv is None)
+            ):
+                return ms.Literal(None, l.ctype, l.scale)
+        return ms.CallBinary(e.func, l, r)
+    if isinstance(e, ms.CallVariadic):
+        parts = [_fold_scalar(x) for x in e.exprs]
+        if e.func == ms.VariadicFunc.AND:
+            if any(
+                isinstance(p, ms.Literal) and p.value is False
+                for p in parts
+            ):
+                return ms.Literal(False, ColumnType.BOOL)
+            parts = [
+                p
+                for p in parts
+                if not (isinstance(p, ms.Literal) and p.value is True)
+            ]
+            if not parts:
+                return ms.Literal(True, ColumnType.BOOL)
+            if len(parts) == 1:
+                return parts[0]
+        elif e.func == ms.VariadicFunc.OR:
+            if any(
+                isinstance(p, ms.Literal) and p.value is True
+                for p in parts
+            ):
+                return ms.Literal(True, ColumnType.BOOL)
+            parts = [
+                p
+                for p in parts
+                if not (isinstance(p, ms.Literal) and p.value is False)
+            ]
+            if not parts:
+                return ms.Literal(False, ColumnType.BOOL)
+            if len(parts) == 1:
+                return parts[0]
+        elif e.func == ms.VariadicFunc.COALESCE:
+            out = []
+            for p in parts:
+                if isinstance(p, ms.Literal) and p.value is None:
+                    continue
+                out.append(p)
+                if isinstance(p, ms.Literal):
+                    break  # later args unreachable
+            if not out:
+                return parts[0] if parts else e
+            if len(out) == 1:
+                return out[0]
+            parts = out
+        return ms.CallVariadic(e.func, parts)
+    if isinstance(e, ms.If):
+        c = _fold_scalar(e.cond)
+        t, f = _fold_scalar(e.then), _fold_scalar(e.els)
+        if isinstance(c, ms.Literal):
+            if c.value is True:
+                return t
+            return f  # False and NULL both take the else branch
+        return ms.If(c, t, f)
+    return e
+
+
 def fold_constants(expr: mir.RelationExpr) -> mir.RelationExpr:
-    """Drop literal-TRUE predicates; empty out literal-FALSE filters
-    (FoldConstants, transform/src/fold_constants.rs — value-level subset)."""
+    """Fold literal scalar subtrees; drop literal-TRUE predicates; empty
+    out literal-FALSE/NULL filters (FoldConstants,
+    transform/src/fold_constants.rs — value-level subset)."""
 
     def rw(e):
+        if isinstance(e, mir.Map) and e.scalars:
+            folded = tuple(_fold_scalar(s) for s in e.scalars)
+            if folded != e.scalars:
+                return mir.Map(e.input, folded)
+            return e
         if isinstance(e, mir.Filter):
             preds = []
             for p in e.predicates:
+                p = _fold_scalar(p)
                 if isinstance(p, ms.Literal):
                     if p.value is True:
                         continue
+                    # False or NULL: no row passes.
                     return mir.Constant((), e.schema())
                 preds.append(p)
             if not preds:
                 return e.input
-            return mir.Filter(e.input, tuple(preds))
+            if tuple(preds) != e.predicates:
+                return mir.Filter(e.input, tuple(preds))
+            return e
+        return e
+
+    return _bottom_up(expr, rw)
+
+
+def column_knowledge(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """ColumnKnowledge (transform/src/column_knowledge.rs), narrow form:
+    per-column non-nullability derived from schemas and filters folds
+    IS_NULL(col) -> false and unwraps COALESCE whose first argument is
+    known non-null. (Constant-value propagation is left to
+    fold_constants + literal Maps.)"""
+
+    def simplify(s: ms.ScalarExpr, sch) -> ms.ScalarExpr:
+        if isinstance(s, ms.CallUnary):
+            inner = simplify(s.expr, sch)
+            if (
+                s.func == ms.UnaryFunc.IS_NULL
+                and isinstance(inner, ms.ColumnRef)
+                and not sch[inner.index].nullable
+            ):
+                return ms.Literal(False, ColumnType.BOOL)
+            return ms.CallUnary(s.func, inner)
+        if isinstance(s, ms.CallBinary):
+            return ms.CallBinary(
+                s.func, simplify(s.left, sch), simplify(s.right, sch)
+            )
+        if isinstance(s, ms.CallVariadic):
+            parts = [simplify(x, sch) for x in s.exprs]
+            if s.func == ms.VariadicFunc.COALESCE and parts:
+                first = parts[0]
+                if (
+                    isinstance(first, ms.ColumnRef)
+                    and not sch[first.index].nullable
+                ) or (
+                    isinstance(first, ms.Literal)
+                    and first.value is not None
+                ):
+                    return first
+            return ms.CallVariadic(s.func, parts)
+        if isinstance(s, ms.If):
+            return ms.If(
+                simplify(s.cond, sch),
+                simplify(s.then, sch),
+                simplify(s.els, sch),
+            )
+        return s
+
+    def rw(e):
+        if isinstance(e, mir.Filter):
+            sch = e.input.schema()
+            preds = tuple(simplify(p, sch) for p in e.predicates)
+            if preds != e.predicates:
+                return mir.Filter(e.input, preds)
+        if isinstance(e, mir.Map):
+            sch = e.input.schema()
+            # Simplify against the progressively extended schema (later
+            # scalars may reference earlier ones).
+            scalars = []
+            ext = list(sch.columns)
+            from ..repr.schema import Column as _Column
+            from ..repr.schema import Schema as _Schema
+
+            changed = False
+            for s in e.scalars:
+                s2 = simplify(s, _Schema(tuple(ext)))
+                changed = changed or (s2 != s)
+                scalars.append(s2)
+                c = s2.typ(_Schema(tuple(ext)))
+                ext.append(
+                    _Column(
+                        f"c{len(ext)}", c.ctype, c.nullable, c.scale
+                    )
+                )
+            if changed:
+                return mir.Map(e.input, tuple(scalars))
         return e
 
     return _bottom_up(expr, rw)
@@ -485,11 +697,471 @@ def plan_distinct_aggregates(expr: mir.RelationExpr) -> mir.RelationExpr:
     return _bottom_up(expr, rw)
 
 
+def canonicalize_join_equivalences(
+    expr: mir.RelationExpr,
+) -> mir.RelationExpr:
+    """Normalize Join equivalence classes so every class is consumable
+    as a cross-input join key (the JoinImplementation precondition the
+    render layer asserts; transform/src/canonicalization +
+    equivalence_propagation.rs):
+
+    - two members in the SAME input -> a local Filter on that input
+      (col_a = col_b), keeping one representative;
+    - a literal member -> a local Filter (col = lit) on every input
+      owning a column member, dropping the literal from the class;
+    - classes left with < 2 members are dropped (their constraint now
+      lives in Filters).
+    """
+
+    def rw(e):
+        if not isinstance(e, mir.Join):
+            return e
+        offsets = [0]
+        for i in e.inputs:
+            offsets.append(offsets[-1] + i.schema().arity)
+
+        def owner(g: int) -> int:
+            for j in range(len(e.inputs)):
+                if offsets[j] <= g < offsets[j + 1]:
+                    return j
+            raise IndexError(g)
+
+        per_input_filters: list = [[] for _ in e.inputs]
+        new_classes = []
+        changed = False
+        for cls in e.equivalences:
+            if not all(
+                isinstance(m, (ms.ColumnRef, ms.Literal)) for m in cls
+            ):
+                # Non-column members: leave the class untouched (the
+                # planner handles what it can; no silent constraint loss).
+                new_classes.append(cls)
+                continue
+            cols: dict = {}  # input -> representative local ColumnRef
+            lits: list = []
+            for m in cls:
+                if isinstance(m, ms.ColumnRef):
+                    j = owner(m.index)
+                    local = ms.ColumnRef(m.index - offsets[j])
+                    if j in cols:
+                        # intra-input equality -> local filter
+                        per_input_filters[j].append(
+                            ms.CallBinary(ms.BinaryFunc.EQ, cols[j], local)
+                        )
+                        changed = True
+                    else:
+                        cols[j] = local
+                else:
+                    lits.append(m)
+            if lits:
+                # col = literal: a local filter on every owning input;
+                # the class collapses entirely (all members equal the
+                # literal, transitively local).
+                if any(l.value != lits[0].value for l in lits[1:]):
+                    return mir.Constant((), e.schema())  # lit1 = lit2 false
+                lit = lits[0]
+                changed = True
+                for j, local in cols.items():
+                    per_input_filters[j].append(
+                        ms.CallBinary(ms.BinaryFunc.EQ, local, lit)
+                    )
+                continue
+            if len(cols) >= 2:
+                kept = tuple(
+                    ms.ColumnRef(c.index + offsets[j])
+                    for j, c in sorted(cols.items())
+                )
+                if len(kept) != len(cls):
+                    changed = True
+                new_classes.append(kept)
+            else:
+                changed = True  # class fully collapsed into filters
+        if not changed:
+            return e
+        new_inputs = tuple(
+            mir.Filter(i, tuple(ps)) if ps else i
+            for i, ps in zip(e.inputs, per_input_filters)
+        )
+        return mir.Join(
+            new_inputs, tuple(new_classes), e.implementation
+        )
+
+    return _bottom_up(expr, rw)
+
+
+def union_cancel(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """UnionBranchCancellation + trivial-branch elision
+    (transform/src/union_cancel.rs): A ∪ Negate(A) cancels; empty
+    Constant branches vanish; a one-branch Union is its branch."""
+
+    def is_empty(b) -> bool:
+        return isinstance(b, mir.Constant) and not b.rows
+
+    def rw(e):
+        if not isinstance(e, mir.Union):
+            return e
+        branches = list(e.inputs)
+        # cancel A with Negate(A) pairwise
+        used = [True] * len(branches)
+        for a in range(len(branches)):
+            if not used[a]:
+                continue
+            for b in range(a + 1, len(branches)):
+                if not used[b]:
+                    continue
+                x, y = branches[a], branches[b]
+                if (
+                    isinstance(y, mir.Negate) and y.input == x
+                ) or (
+                    isinstance(x, mir.Negate) and x.input == y
+                ):
+                    used[a] = used[b] = False
+                    break
+        kept = [
+            b for b, u in zip(branches, used) if u and not is_empty(b)
+        ]
+        if len(kept) == len(branches):
+            return e
+        if not kept:
+            return mir.Constant((), e.schema())
+        if len(kept) == 1:
+            return kept[0]
+        return mir.Union(tuple(kept))
+
+    return _bottom_up(expr, rw)
+
+
+def reduce_elision(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """ReduceElision (transform/src/reduce_elision.rs), narrow form:
+    a Distinct (Reduce with no aggregates) whose input is already
+    distinct on the same key — e.g. another Reduce keyed identically —
+    is the identity."""
+
+    def distinct_on(e, key: tuple) -> bool:
+        if isinstance(e, mir.Reduce):
+            return tuple(range(len(e.group_key))) == key or (
+                key == tuple(range(e.schema().arity))
+            )
+        return False
+
+    def rw(e):
+        if (
+            isinstance(e, mir.Reduce)
+            and not e.aggregates
+            and distinct_on(e.input, e.group_key)
+            and e.group_key == tuple(range(e.input.schema().arity))
+        ):
+            return e.input
+        return e
+
+    return _bottom_up(expr, rw)
+
+
+def redundant_join(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """RedundantJoin (transform/src/redundant_join.rs), narrow form:
+    eliminate single-row Constant inputs from a join — the shape
+    decorrelated scalar subqueries and literal-lifted inputs produce.
+    The constant's columns become Map literals; equivalences touching
+    them become Filters."""
+
+    def rw(e):
+        if not isinstance(e, mir.Join) or len(e.inputs) < 2:
+            return e
+        offsets = [0]
+        for i in e.inputs:
+            offsets.append(offsets[-1] + i.schema().arity)
+        victim = None
+        for j, inp in enumerate(e.inputs):
+            if (
+                isinstance(inp, mir.Constant)
+                and len(inp.rows) == 1
+                and inp.rows[0][1] == 1
+            ):
+                victim = j
+                break
+        if victim is None:
+            return e
+        vals, _d = e.inputs[victim].rows[0]
+        vschema = e.inputs[victim].schema()
+        lo, hi = offsets[victim], offsets[victim + 1]
+
+        def lit_for(g: int) -> ms.Literal:
+            c = vschema[g - lo]
+            return ms.Literal(vals[g - lo], c.ctype, c.scale)
+
+        rest = [i for j, i in enumerate(e.inputs) if j != victim]
+        # Global remap: columns after the victim shift left; victim
+        # columns become appended Map literals at the end.
+        rest_arity = offsets[-1] - (hi - lo)
+        mapping = {}
+        for g in range(offsets[-1]):
+            if g < lo:
+                mapping[g] = g
+            elif g >= hi:
+                mapping[g] = g - (hi - lo)
+            else:
+                mapping[g] = rest_arity + (g - lo)
+        filters = []
+        new_equivs = []
+        for cls in e.equivalences:
+            kept_members = []
+            lit_members = []
+            for m in cls:
+                if isinstance(m, ms.ColumnRef) and lo <= m.index < hi:
+                    lit_members.append(lit_for(m.index))
+                else:
+                    kept_members.append(m)
+            if lit_members and kept_members:
+                for m in kept_members:
+                    shifted = _shift_scalar(m, mapping)
+                    if shifted is None:
+                        return e  # give up, keep original join
+                    filters.append(
+                        ms.CallBinary(
+                            ms.BinaryFunc.EQ, shifted, lit_members[0]
+                        )
+                    )
+            elif len(kept_members) >= 2:
+                shifted = [
+                    _shift_scalar(m, mapping) for m in kept_members
+                ]
+                if any(s is None for s in shifted):
+                    return e
+                new_equivs.append(tuple(shifted))
+        if len(rest) == 1:
+            base = rest[0]
+        else:
+            base = mir.Join(tuple(rest), tuple(new_equivs),
+                            e.implementation)
+            new_equivs = []
+        if new_equivs:
+            return e  # single remaining input can't host equivalences
+        out = mir.Map(
+            base, tuple(lit_for(g) for g in range(lo, hi))
+        )
+        if filters:
+            out = mir.Filter(out, tuple(filters))
+        # Restore the original column order.
+        out = mir.Project(
+            out, tuple(mapping[g] for g in range(offsets[-1]))
+        )
+        return out
+
+    return _bottom_up(expr, rw)
+
+
+def projection_pushdown(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """Demand / ProjectionPushdown
+    (transform/src/movement/projection_pushdown.rs, demand.rs): move
+    column pruning toward sources so arrangements and exchanges carry
+    only live columns. On TPU this is a first-order win: row width =
+    sort-lane count = HBM traffic per merge/probe.
+
+    Multiset-correct everywhere it fires: Project sums multiplicities of
+    rows that collapse, which is exactly SQL projection; it is NOT
+    pushed through row-identity-sensitive operators (Threshold, TopK,
+    FlatMap)."""
+
+    def out_refs(outputs) -> set:
+        return set(outputs)
+
+    def rw(e):
+        # Demand from Reduce: prune its input to key + aggregate refs.
+        if isinstance(e, mir.Reduce):
+            arity = e.input.schema().arity
+            needed: set = set(e.group_key)
+            for a in e.aggregates:
+                _refs(a.expr, needed)
+            if not needed:
+                # Zero-column relations are not representable on device
+                # (a Batch needs >=1 column); keep one.
+                needed = {0}
+            if len(needed) < arity:
+                keep = sorted(needed)
+                remap = {src: i for i, src in enumerate(keep)}
+                aggs = []
+                ok = True
+                for a in e.aggregates:
+                    sh = _shift_scalar(a.expr, remap)
+                    if sh is None:
+                        ok = False
+                        break
+                    aggs.append(AggregateExpr(a.func, sh, a.distinct))
+                if ok:
+                    return mir.Reduce(
+                        mir.Project(e.input, tuple(keep)),
+                        tuple(remap[k] for k in e.group_key),
+                        tuple(aggs),
+                    )
+            return e
+        if not isinstance(e, mir.Project):
+            return e
+        inp, outputs = e.input, e.outputs
+        arity = inp.schema().arity
+
+        if isinstance(inp, mir.Constant):
+            rows = tuple(
+                (tuple(vals[i] for i in outputs), d)
+                for vals, d in inp.rows
+            )
+            return mir.Constant(rows, e.schema())
+
+        if isinstance(inp, mir.Negate):
+            return mir.Negate(mir.Project(inp.input, outputs))
+
+        if isinstance(inp, mir.Union):
+            if len(set(outputs)) < arity:
+                return mir.Union(
+                    tuple(
+                        mir.Project(b, outputs) for b in inp.inputs
+                    )
+                )
+            return e
+
+        if isinstance(inp, mir.Filter):
+            needed: set = out_refs(outputs)
+            for p in inp.predicates:
+                _refs(p, needed)
+            if not needed:
+                needed = {0}
+            if len(needed) < arity:
+                keep = sorted(needed)
+                remap = {src: i for i, src in enumerate(keep)}
+                preds = tuple(
+                    _shift_scalar(p, remap) for p in inp.predicates
+                )
+                if all(p is not None for p in preds):
+                    return mir.Project(
+                        mir.Filter(
+                            mir.Project(inp.input, tuple(keep)), preds
+                        ),
+                        tuple(remap[o] for o in outputs),
+                    )
+            return e
+
+        if isinstance(inp, mir.Map):
+            base = inp.input.schema().arity
+            # Transitive demand: kept scalars may reference earlier ones.
+            needed: set = out_refs(outputs)
+            for i in range(len(inp.scalars) - 1, -1, -1):
+                if base + i in needed:
+                    _refs(inp.scalars[i], needed)
+            kept_scalars = [
+                i for i in range(len(inp.scalars)) if base + i in needed
+            ]
+            needed_base = sorted(c for c in needed if c < base)
+            if not needed_base:
+                needed_base = [0]  # zero-column relations unrepresentable
+            if len(needed_base) == base and len(kept_scalars) == len(
+                inp.scalars
+            ):
+                return e
+            remap = {src: i for i, src in enumerate(needed_base)}
+            for pos, i in enumerate(kept_scalars):
+                remap[base + i] = len(needed_base) + pos
+            scalars = []
+            for i in kept_scalars:
+                sh = _shift_scalar(inp.scalars[i], remap)
+                if sh is None:
+                    return e
+                scalars.append(sh)
+            new_in = (
+                mir.Project(inp.input, tuple(needed_base))
+                if len(needed_base) < base
+                else inp.input
+            )
+            new_map = (
+                mir.Map(new_in, tuple(scalars)) if scalars else new_in
+            )
+            return mir.Project(
+                new_map, tuple(remap[o] for o in outputs)
+            )
+
+        if isinstance(inp, mir.Join):
+            offsets = [0]
+            for i in inp.inputs:
+                offsets.append(offsets[-1] + i.schema().arity)
+            needed: set = out_refs(outputs)
+            for cls in inp.equivalences:
+                for m in cls:
+                    _refs(m, needed)
+            if len(needed) == offsets[-1]:
+                return e
+            # per-input keep lists + global remap
+            keeps = []
+            remap = {}
+            new_pos = 0
+            for j in range(len(inp.inputs)):
+                keep_j = sorted(
+                    c - offsets[j]
+                    for c in needed
+                    if offsets[j] <= c < offsets[j + 1]
+                )
+                if not keep_j:
+                    # zero-column relations are not representable
+                    keep_j = [0]
+                keeps.append(keep_j)
+                for local in keep_j:
+                    remap[offsets[j] + local] = new_pos
+                    new_pos += 1
+            new_inputs = []
+            for j, (i_j, keep_j) in enumerate(zip(inp.inputs, keeps)):
+                a_j = i_j.schema().arity
+                new_inputs.append(
+                    mir.Project(i_j, tuple(keep_j))
+                    if len(keep_j) < a_j
+                    else i_j
+                )
+            equivs = []
+            for cls in inp.equivalences:
+                shifted = tuple(
+                    _shift_scalar(m, remap) for m in cls
+                )
+                if any(s is None for s in shifted):
+                    return e
+                equivs.append(shifted)
+            return mir.Project(
+                mir.Join(
+                    tuple(new_inputs), tuple(equivs), inp.implementation
+                ),
+                tuple(remap[o] for o in outputs),
+            )
+
+        if isinstance(inp, mir.Reduce):
+            nk = len(inp.group_key)
+            used_aggs = sorted(
+                {o - nk for o in outputs if o >= nk}
+            )
+            if len(used_aggs) < len(inp.aggregates):
+                remap = {i: i for i in range(nk)}
+                for pos, a in enumerate(used_aggs):
+                    remap[nk + a] = nk + pos
+                return mir.Project(
+                    mir.Reduce(
+                        inp.input,
+                        inp.group_key,
+                        tuple(inp.aggregates[a] for a in used_aggs),
+                    ),
+                    tuple(remap[o] for o in outputs),
+                )
+            return e
+
+        return e
+
+    return _bottom_up(expr, rw)
+
+
 LOGICAL_TRANSFORMS = (
     plan_distinct_aggregates,
     fuse,
     fold_constants,
+    column_knowledge,
     predicate_pushdown,
+    canonicalize_join_equivalences,
+    union_cancel,
+    reduce_elision,
+    redundant_join,
+    projection_pushdown,
     threshold_elision,
 )
 PHYSICAL_TRANSFORMS = (join_implementation,)

@@ -328,3 +328,51 @@ class TestMaintainedView:
         # Hydrates at as_of=4 (the compacted since), then catches up.
         mv.run_until(6)
         assert as_multiset(mv.peek()) == {(1, 6): 1}
+
+
+class TestDeviceResidentIndexSharing:
+    """Round-3 item: same-process index imports stay ON DEVICE — the
+    publisher's output spine is the snapshot and its step deltas are the
+    pushed batches; no host round-trip on the sharing path (the
+    TraceManager shares traces in memory, arrangement/manager.rs:33)."""
+
+    def test_publisher_to_subscriber_zero_host_transfers(self):
+        from materialize_tpu.storage.persist import IndexSource
+
+        c = PersistClient(MemBlob(), MemConsensus())
+        w = c.open_writer("kv", KV)
+        for t, ups in enumerate(
+            [[(1, 10, 1), (2, 20, 1)], [(1, 5, 1)], [(2, 20, -1)]]
+        ):
+            w.compare_and_append(*_updates(ups, t=t), t, t + 1)
+
+        # Publisher: an INDEX (no output shard) on the summed view.
+        pub = MaintainedView(
+            c, Dataflow(_q1ish_mir()), {"kv": ("kv", KV)}, None
+        )
+        pub.run_until(3)
+        assert as_multiset(pub.peek()) == {(1, 15): 1}
+
+        # Subscriber imports the index: threshold-style downstream view.
+        sub_schema = pub.df.out_schema
+        isrc = IndexSource(pub, sub_schema)
+        sub_expr = mir.Get("agg", sub_schema).filter(
+            [col(1).gte(col(1))]  # identity-ish filter, keeps rows
+        )
+        sub = MaintainedView(
+            c2 := c, Dataflow(sub_expr), {}, None,
+            index_sources={"agg": isrc},
+        )
+        assert isrc._device, "same-process single-device import"
+        assert isrc.host_transfers == 0
+        assert as_multiset(sub.peek()) == as_multiset(pub.peek())
+
+        # Deltas flow device->device: new input propagates through the
+        # publisher step into the subscriber without host transfers.
+        w.compare_and_append(*_updates([(3, 7, 1)], t=3), 3, 4)
+        pub.run_until(4)
+        sub.run_until(4)
+        assert isrc.host_transfers == 0
+        assert as_multiset(sub.peek()) == as_multiset(pub.peek())
+        got = as_multiset(sub.peek())
+        assert got[(3, 7)] == 1
