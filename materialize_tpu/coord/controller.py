@@ -130,6 +130,8 @@ class ComputeController:
         self.frontiers: dict[str, dict[str, int]] = {}  # df -> replica -> upper
         self.arrangement_records: dict[str, dict[str, int]] = {}
         self.statuses: deque = deque(maxlen=1000)  # replica error reports
+        # Install acks: df name -> replica -> error string | None (ok).
+        self.install_acks: dict[str, dict] = {}
         self._peek_results: dict[int, dict] = {}
         self._peek_events: dict[int, threading.Event] = {}
         self._absorber = threading.Thread(
@@ -137,6 +139,31 @@ class ComputeController:
         )
         self._stop = threading.Event()
         self._absorber.start()
+        # In-process dictionary rebalance (repr/schema.py): the command
+        # history's MIR literals hold string codes; remap them so a
+        # later reconnect replays valid plans. (A separate-process
+        # replica keeps its own dictionary and is not affected.)
+        from ..repr.schema import GLOBAL_DICT
+
+        def _on_rebalance(remap, _self=self):
+            from ..expr.remap import remap_relation
+            import dataclasses as _dc
+
+            with _self._lock:
+                for name, cmd in list(_self._dataflows.items()):
+                    desc = cmd.get("desc")
+                    if desc is None:
+                        continue
+                    new_expr = remap_relation(desc.expr, remap)
+                    if new_expr is not desc.expr:
+                        cmd = dict(cmd)
+                        cmd["desc"] = _dc.replace(
+                            desc, expr=new_expr
+                        )
+                        _self._dataflows[name] = cmd
+
+        self._rebalance_listener = _on_rebalance
+        GLOBAL_DICT.add_rebalance_listener(_on_rebalance)
 
     # -- replica management --------------------------------------------------
     def add_replica(self, name: str, addr: tuple[str, int]) -> None:
@@ -174,13 +201,45 @@ class ComputeController:
         cmd = ctp.create_dataflow(desc)
         with self._lock:
             self._dataflows[desc.name] = cmd
+            self.install_acks.pop(desc.name, None)
         self._broadcast(cmd)
+
+    def wait_installed(self, name: str, timeout: float = 30.0) -> None:
+        """Block until some replica acks the install (ok), or raise the
+        replica-reported error once every connected replica has failed
+        it. Surfaces bad plans at DDL time instead of as a later
+        "no such dataflow" peek error. No replicas -> returns (the
+        dataflow installs on the next replica connect via history)."""
+        deadline = _time.monotonic() + timeout
+        while True:
+            # Only CONNECTED replicas owe an ack: a dead/reconnecting
+            # replica gets the dataflow from history replay later, and
+            # must not stall DDL (chaos kills replicas mid-run).
+            with self._lock:
+                connected = [
+                    r
+                    for r, rc in self.replicas.items()
+                    if rc.connected.is_set()
+                ]
+                acks = dict(self.install_acks.get(name, {}))
+            if not connected:
+                return
+            if any(e is None for e in acks.values()):
+                return
+            if acks and all(r in acks for r in connected):
+                raise RuntimeError(next(iter(acks.values())))
+            if _time.monotonic() >= deadline:
+                if acks:
+                    raise RuntimeError(next(iter(acks.values())))
+                return  # slow hydration is not an error
+            _time.sleep(0.005)
 
     def drop_dataflow(self, name: str) -> None:
         with self._lock:
             self._dataflows.pop(name, None)
             self.frontiers.pop(name, None)
             self.arrangement_records.pop(name, None)
+            self.install_acks.pop(name, None)
         self._broadcast(ctp.drop_dataflow(name))
 
     def allow_compaction(self, dataflow: str, since: int) -> None:
@@ -243,6 +302,11 @@ class ComputeController:
             elif kind == "Status":
                 with self._lock:
                     self.statuses.append(msg)
+            elif kind == "DataflowInstalled":
+                with self._lock:
+                    self.install_acks.setdefault(msg["name"], {})[
+                        msg["__replica__"]
+                    ] = msg.get("error")
             elif kind == "PeekResponse":
                 pid = msg["peek_id"]
                 with self._lock:
@@ -285,5 +349,8 @@ class ComputeController:
 
     def shutdown(self) -> None:
         self._stop.set()
+        from ..repr.schema import GLOBAL_DICT
+
+        GLOBAL_DICT.remove_rebalance_listener(self._rebalance_listener)
         for rc in self.replicas.values():
             rc.stop()

@@ -117,6 +117,10 @@ class Coordinator:
         )
         self._item_seq = 0
         self._transient_seq = 0
+        # Net durable effects of the CURRENT statement (appends minus
+        # retractions): the DictExhausted replan-retry in execute() is
+        # only safe when the failed attempt left no net durable state.
+        self._net_durable = 0
         self._lock = threading.RLock()
         # Introspection relations (mz_internal analog): virtual items
         # resolved to snapshots at peek time (introspection.py).
@@ -139,11 +143,17 @@ class Coordinator:
 
         @contextlib.contextmanager
         def cm():
-            coord._lock.release()
+            # Bootstrap (and other pre-serve paths) call sequencing
+            # helpers without holding the lock: releasing an un-owned
+            # RLock raises, so only drop it when this thread holds it.
+            held = coord._lock._is_owned()
+            if held:
+                coord._lock.release()
             try:
                 yield
             finally:
-                coord._lock.acquire()
+                if held:
+                    coord._lock.acquire()
 
         return cm()
 
@@ -153,6 +163,7 @@ class Coordinator:
 
     # -- durable catalog ----------------------------------------------------
     def _catalog_append(self, record: dict, diff: int) -> None:
+        self._net_durable += 1 if diff > 0 else -1
         code = GLOBAL_DICT.encode(json.dumps(record, sort_keys=True))
         t = self._cat_writer.upper
         self._cat_writer.compare_and_append(
@@ -187,18 +198,52 @@ class Coordinator:
         re-selected by the replicas on CreateDataflow)."""
         for rec in self._catalog_live_records():
             self._item_seq = max(self._item_seq, rec["id"])
-            self._sequence(
-                plan_statement(rec["sql"], self.catalog),
-                sql=rec["sql"],
-                replay=True,
-                record=rec,
-            )
+            try:
+                self._sequence(
+                    plan_statement(rec["sql"], self.catalog),
+                    sql=rec["sql"],
+                    replay=True,
+                    record=rec,
+                )
+            except Exception as e:
+                # A record that no longer replays (e.g. its install was
+                # compensated mid-crash) must not brick the boot:
+                # retract it and keep going. Dependents fail the same
+                # way and retract too — self-healing, at the cost of
+                # dropping the broken item (surfaced in statuses).
+                self.controller.statuses.append(
+                    {
+                        "kind": "Status",
+                        "error": f"bootstrap replay of {rec['sql']!r} "
+                        f"failed ({e!r}); record retracted",
+                    }
+                )
+                self._catalog_append(rec, -1)
 
     # -- statement execution -------------------------------------------------
     def execute(self, sql: str) -> ExecuteResult:
+        from ..repr.schema import DictExhausted
+
         with self._lock:
-            plan = plan_statement(sql, self.catalog)
-            return self._sequence(plan, sql=sql)
+            before = self._net_durable
+            try:
+                plan = plan_statement(sql, self.catalog)
+                return self._sequence(plan, sql=sql)
+            except DictExhausted:
+                # Planning (or an in-process replica this statement
+                # drove) ran a string-label gap dry. Rebalance the
+                # process dictionary — listeners remap the controller's
+                # command history and queue rebuilds on in-process
+                # replica workers — then replan from SQL text, which
+                # re-encodes literals under the new labeling. Only safe
+                # when the failed attempt left no NET durable state
+                # (DDL compensation retracts its record on failure;
+                # a completed table write cannot be undone -> re-raise).
+                if self._net_durable != before:
+                    raise
+                GLOBAL_DICT.rebalance()
+                plan = plan_statement(sql, self.catalog)
+                return self._sequence(plan, sql=sql)
 
     def _sequence(
         self, plan, sql: str, replay: bool = False, record: dict | None = None
@@ -553,6 +598,22 @@ class Coordinator:
             )
             return len(norm)
 
+    @staticmethod
+    def _temporal_to_int(v, col):
+        """date/datetime objects -> epoch day / epoch ms ints (identity
+        on ints: SLTs may still write raw epoch numbers)."""
+        import datetime as _dt
+
+        from ..repr.schema import date_to_days, ts_to_ms
+
+        if col.ctype is ColumnType.TIMESTAMP and isinstance(
+            v, _dt.datetime
+        ):
+            return ts_to_ms(v)
+        if col.ctype is ColumnType.DATE and isinstance(v, _dt.date):
+            return date_to_days(v)
+        return v
+
     def _encode_insert(self, schema: Schema, rows: list):
         cols, nulls = [], []
         for j, col in enumerate(schema.columns):
@@ -570,7 +631,7 @@ class Coordinator:
                 elif col.ctype is ColumnType.BOOL:
                     vals.append(bool(v))
                 else:
-                    vals.append(v)
+                    vals.append(self._temporal_to_int(v, col))
             cols.append(np.asarray(vals, dtype=col.dtype))
             nulls.append(np.asarray(mask, bool) if any(mask) else None)
         return cols, nulls
@@ -622,6 +683,7 @@ class Coordinator:
         return len(rows)
 
     def _group_commit(self, table: str, cols, nulls, diffs) -> int:
+        self._net_durable += 1
         """Group commit on the shared table timeline (coord/appends.rs
         + txn-wal): allocate one write timestamp past every table
         upper, write the target table, advance all other tables to the
@@ -668,7 +730,8 @@ class Coordinator:
             DataflowDescription(
                 name=name, expr=expr, source_imports=imports,
                 sink_shard=None, index_imports=index_imports,
-            )
+            ),
+            unlocked=unlocked,
         )
         try:
             as_of = self._select_timestamp_shards(
@@ -720,7 +783,7 @@ class Coordinator:
                 elif col.ctype is ColumnType.DECIMAL and col.scale:
                     vals.append(int(v * (10 ** col.scale)))
                 else:
-                    vals.append(v)
+                    vals.append(self._temporal_to_int(v, col))
             cols.append(np.asarray(vals, dtype=col.dtype))
             nulls.append(np.asarray(mask, bool) if any(mask) else None)
         return cols, nulls
@@ -816,10 +879,15 @@ class Coordinator:
         def walk(e):
             if isinstance(e, mir.Get):
                 it = self.catalog.items.get(e.name)
-                if (
-                    it is not None
-                    and it.kind == "view"
-                    and e.name not in self.peekable
+                if it is not None and it.kind == "view" and (
+                    e.name not in self.peekable
+                    # Basic-aggregate views are ALWAYS inlined, even
+                    # when indexed: their index arrangement carries
+                    # opaque digests that only the serving dataflow's
+                    # own edge finalization can materialize — importing
+                    # it into another dataflow would leak digests
+                    # (doc/aggregates.md restrictions).
+                    or _has_basic_aggs(it.definition, self.catalog)
                 ):
                     return walk(it.definition)
                 return e
@@ -890,15 +958,25 @@ class Coordinator:
             # Shard named by the unique record id: DROP + re-CREATE of
             # the same name must NOT resume from the old MV's data.
             shard = f"u{record['id']}_mv"
-            self._register_dataflow(
-                DataflowDescription(
-                    name=plan.name,
-                    expr=inlined,
-                    source_imports=imports,
-                    sink_shard=shard,
-                    index_imports=index_imports,
+            try:
+                self._register_dataflow(
+                    DataflowDescription(
+                        name=plan.name,
+                        expr=inlined,
+                        source_imports=imports,
+                        sink_shard=shard,
+                        index_imports=index_imports,
+                    )
                 )
-            )
+            except BaseException:
+                # Compensate: a poison record that fails on replay
+                # would brick every future boot. On REPLAY the record
+                # belongs to _bootstrap, which retracts it itself — a
+                # second retraction here would drive the ledger sum
+                # negative and could mask a future identical record.
+                if not replay:
+                    self._catalog_append(record, -1)
+                raise
             self.catalog.create(
                 CatalogItem(
                     name=plan.name,
@@ -955,17 +1033,23 @@ class Coordinator:
         else:
             raise PlanError(f"cannot index {it.kind} {plan.on!r}")
         imports, index_imports = self._source_imports(expr)
+        idx_record = None
         if not replay:
-            self._record_ddl(sql, {"name": plan.name})
-        self._register_dataflow(
-            DataflowDescription(
-                name=plan.name,
-                expr=expr,
-                source_imports=imports,
-                sink_shard=None,
-                index_imports=index_imports,
+            idx_record = self._record_ddl(sql, {"name": plan.name})
+        try:
+            self._register_dataflow(
+                DataflowDescription(
+                    name=plan.name,
+                    expr=expr,
+                    source_imports=imports,
+                    sink_shard=None,
+                    index_imports=index_imports,
+                )
             )
-        )
+        except BaseException:
+            if idx_record is not None:
+                self._catalog_append(idx_record, -1)
+            raise
         self.catalog.create(
             CatalogItem(
                 name=plan.name,
@@ -1143,7 +1227,7 @@ class Coordinator:
 
         df = Dataflow(subst(expr))
         df.step({})
-        rows = _decode_peek_rows(df.output_batch())
+        rows = _decode_peek_rows(df.output_batch(), df)
         return ExecuteResult(
             "rows",
             rows=_finish(rows, plan.order_by,
@@ -1193,7 +1277,9 @@ class Coordinator:
             schema=expr.schema(),
         )
 
-    def _register_dataflow(self, desc: DataflowDescription) -> None:
+    def _register_dataflow(
+        self, desc: DataflowDescription, unlocked: bool = True
+    ) -> None:
         # Transitive upstream shards: index imports contribute their
         # PUBLISHER's upstream so timestamp selection for reads over
         # shared arrangements still sees the real persist inputs.
@@ -1206,10 +1292,26 @@ class Coordinator:
         }
         try:
             self.controller.create_dataflow(desc)
+            # Surface replica-side install failures AT DDL TIME: a bad
+            # plan raises here instead of leaving a ghost dataflow that
+            # every later peek reports as "no such dataflow". The wait
+            # covers hydration, so release the sequencing lock unless
+            # the caller needs read-write atomicity (DML).
+            if unlocked:
+                with self._unlocked():
+                    self.controller.wait_installed(desc.name)
+            else:
+                self.controller.wait_installed(desc.name)
         except BaseException:
             # A failed install must not leave importer bookkeeping that
-            # would permanently block DROP INDEX on the publisher.
+            # would permanently block DROP INDEX on the publisher, NOR
+            # a ghost command in the controller history that every
+            # replica reconnect would replay forever.
             self._deregister_dataflow(desc.name)
+            try:
+                self.controller.drop_dataflow(desc.name)
+            except Exception:
+                pass
             raise
 
     def _deregister_dataflow(self, name: str) -> None:
@@ -1388,6 +1490,31 @@ class _Rev:
 
     def __lt__(self, other):
         return other.v < self.v
+
+
+def _has_basic_aggs(expr, catalog=None, _seen=None) -> bool:
+    """Does any Reduce in this MIR tree use a basic (collection)
+    aggregate? Such plans finalize at their own serving edge and cannot
+    be shared through index imports. With a catalog, Get(view) leaves
+    resolve TRANSITIVELY (a wrapper view over a basic-aggregate view
+    inlines that view, so its dataflow carries the finalizers too)."""
+    if isinstance(expr, mir.Reduce) and any(
+        a.func.is_basic for a in expr.aggregates
+    ):
+        return True
+    if catalog is not None and isinstance(expr, mir.Get):
+        seen = _seen or set()
+        if expr.name in seen:
+            return False
+        it = catalog.items.get(expr.name)
+        if it is not None and it.kind == "view":
+            return _has_basic_aggs(
+                it.definition, catalog, seen | {expr.name}
+            )
+        return False
+    return any(
+        _has_basic_aggs(c, catalog, _seen) for c in expr.children()
+    )
 
 
 def _rewrite_children(e: mir.RelationExpr, fn) -> mir.RelationExpr:

@@ -30,6 +30,20 @@ class AggregateFunc(enum.Enum):
     MAX = "max"            # hierarchical
     ANY = "any"            # accumulable over bools (true count > 0)
     ALL = "all"            # accumulable (false count == 0)
+    # Basic (collection) aggregates — the analog of the reference's
+    # build_basic_aggregate tier (compute/src/render/reduce.rs:369;
+    # StringAgg / ArrayConcat / ListConcat in expr/src/relation/
+    # func.rs:1878). The maintained device state is the sorted
+    # (group key, value) multiset plus an order-insensitive digest
+    # accumulator for change detection; the variable-width result is
+    # produced at the serving edge (Dataflow.peek) where a host
+    # readback happens anyway — variable-width concatenation per step
+    # would break the zero-readback hot loop. Values order by
+    # dictionary code == lexicographic order, so the output is
+    # deterministic (pg leaves un-ORDER BY'd aggs unspecified).
+    STRING_AGG = "string_agg"  # basic: join with separator
+    ARRAY_AGG = "array_agg"    # basic: pg-style {a,b,c} text rendering
+    LIST_AGG = "list_agg"      # basic: mz list, same rendering
 
     @property
     def is_accumulable(self) -> bool:
@@ -45,6 +59,14 @@ class AggregateFunc(enum.Enum):
     def is_hierarchical(self) -> bool:
         return self in (AggregateFunc.MIN, AggregateFunc.MAX)
 
+    @property
+    def is_basic(self) -> bool:
+        return self in (
+            AggregateFunc.STRING_AGG,
+            AggregateFunc.ARRAY_AGG,
+            AggregateFunc.LIST_AGG,
+        )
+
 
 @dataclass(frozen=True)
 class AggregateExpr:
@@ -54,6 +76,10 @@ class AggregateExpr:
     func: AggregateFunc
     expr: ScalarExpr
     distinct: bool = False
+    # Host-side parameters (e.g. string_agg's separator TEXT). Part of
+    # the plan, not a scalar input: basic-aggregate finalization runs at
+    # the serving edge on the host.
+    params: tuple = ()
 
     def output_col(self, input_schema: Schema) -> Column:
         inner = self.expr.typ(input_schema)
@@ -69,6 +95,11 @@ class AggregateExpr:
             )
         if self.func in (AggregateFunc.ANY, AggregateFunc.ALL):
             return Column(self.func.value, ColumnType.BOOL, True)
+        if self.func.is_basic:
+            # The device column carries an opaque change-detection
+            # digest until edge finalization substitutes the encoded
+            # result string (ops/reduce.py basic tier).
+            return Column(self.func.value, ColumnType.STRING, True)
         raise NotImplementedError(self.func)
 
 

@@ -711,7 +711,12 @@ def eval_expr(expr: ScalarExpr, batch: Batch, time=None) -> Evaled:
                     ),
                 )
                 safe = jnp.where(zero, 1, rv)
-                v = (lv * (10**r.col.scale)) // safe
+                # Both operands are at col.scale after rescaling, so
+                # the scale-preserving quotient multiplies by
+                # 10^col.scale (NOT the divisor's original scale —
+                # decimal/int division like avg's sum/count would
+                # otherwise come out 10^scale too small).
+                v = (lv * (10**col.scale)) // safe
                 nulls = _or_nulls(nulls, zero)
                 return Evaled(v, nulls, col)
         if f == BinaryFunc.ADD:

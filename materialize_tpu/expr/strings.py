@@ -193,8 +193,17 @@ class _EnvCache:
         # re-sorts arrays with numpy (streaming workloads stay
         # O(new strings) Python work per step, not O(dict)).
         self._done: dict[str, dict] = {}
+        self._epoch = 0
 
     def table(self, key: str) -> tuple:
+        # A rebalance relabeled every code: tables (label arrays) and
+        # done maps (keyed by label, str-kind values are labels too)
+        # are all garbage. Full reset.
+        if self._epoch != GLOBAL_DICT.epoch:
+            self._tables.clear()
+            self._version.clear()
+            self._done.clear()
+            self._epoch = GLOBAL_DICT.epoch
         parts = key.split("\x00")
         func, params = parts[0], tuple(parts[1:])
         kind = RESULT_KINDS[func]
@@ -207,14 +216,29 @@ class _EnvCache:
         ):
             return cached
         done = self._done.setdefault(key, {})
-        pairs = GLOBAL_DICT.items_sorted()  # snapshot: results may
-        for code, s in pairs:               # grow the dict mid-loop
-            if code in done:
-                continue
-            v = _apply(func, params, s)
-            if kind == "str":
-                v = GLOBAL_DICT.encode(v)
-            done[code] = v
+        # Version BEFORE the build: encoding 'str'-kind results below
+        # grows the dictionary, and the table only covers the pre-build
+        # snapshot — stamping the post-build version would make the next
+        # build_env pass treat this stale table as current (self-nested
+        # calls like upper(upper(x)) then gather garbage).
+        pre_version = GLOBAL_DICT.version
+        pairs = GLOBAL_DICT.items_sorted()  # snapshot
+        todo = [(c, s) for c, s in pairs if c not in done]
+        if kind == "str":
+            # Two-phase: compute every result first, BULK-insert the
+            # new strings (positional gap division — one-at-a-time
+            # content interpolation packs long-common-prefix result
+            # families into slivers and exhausts gaps; encode_bulk
+            # divides each gap evenly by run length), then map.
+            results = [
+                (c, _apply(func, params, s)) for c, s in todo
+            ]
+            GLOBAL_DICT.encode_bulk([v for _, v in results])
+            for c, v in results:
+                done[c] = GLOBAL_DICT.encode(v)
+        else:
+            for c, s in todo:
+                done[c] = _apply(func, params, s)
         n = len(pairs)
         tier = capacity_tier(max(n, 1))
         labels = np.full(tier, GLOBAL_DICT.MAX_LABEL, dtype=np.int64)
@@ -222,7 +246,7 @@ class _EnvCache:
         values = np.zeros(tier, dtype=dtype)
         values[:n] = [done[c] for c, _ in pairs]
         self._tables[key] = (labels, values)
-        self._version[key] = GLOBAL_DICT.version
+        self._version[key] = pre_version
         return self._tables[key]
 
 

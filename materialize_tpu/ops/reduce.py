@@ -68,10 +68,56 @@ def _accum_cols(j: int, agg: AggregateExpr, input_schema: Schema):
             Column(f"__a{j}_cnt__", ColumnType.INT64),
             Column(f"__a{j}_nn__", ColumnType.INT64),
         ]
+    if agg.func.is_basic:
+        # Order-insensitive multiset digest: sum of mix64(value)*diff.
+        # Drives retract/insert change detection for the output row;
+        # the actual variable-width result is produced at the serving
+        # edge from the multiset state part (finalize_basic).
+        return [
+            Column(f"__a{j}_mix__", ColumnType.INT64),
+            Column(f"__a{j}_nn__", ColumnType.INT64),
+        ]
     raise NotImplementedError(
         f"{agg.func} is not accumulable (hierarchical aggregates are "
         "handled by the bucketed reduce, ops/hierarchy.py)"
     )
+
+
+# splitmix64 finalizer constants: the digest must be non-linear in the
+# values so structurally related multisets (same count and sum) do not
+# collide — a plain sum would make {1,4} and {2,3} indistinguishable.
+_MIX_K1 = 0xBF58476D1CE4E5B9
+_MIX_K2 = 0x94D049BB133111EB
+
+
+# Pre-whitening constant: splitmix64's finalizer fixes 0, so a bare
+# mix(0) == 0 would make zero-valued elements invisible to the digest
+# ({0} ∪ S and S would collide). XOR a nonzero constant first.
+_MIX_PRE = 0xA5A5A5A5A5A5A5A5
+
+
+def _mix64_device(v: jnp.ndarray) -> jnp.ndarray:
+    x = v.astype(jnp.uint64) ^ jnp.uint64(_MIX_PRE)
+    x = x ^ (x >> jnp.uint64(33))
+    x = x * jnp.uint64(_MIX_K1)
+    x = x ^ (x >> jnp.uint64(29))
+    x = x * jnp.uint64(_MIX_K2)
+    x = x ^ (x >> jnp.uint64(32))
+    return x.astype(jnp.int64)
+
+
+def _mix64_host(v) -> "np.ndarray":
+    import numpy as np
+
+    x = np.asarray(v, dtype=np.int64).astype(np.uint64) ^ np.uint64(
+        _MIX_PRE
+    )
+    x = x ^ (x >> np.uint64(33))
+    x = x * np.uint64(_MIX_K1)
+    x = x ^ (x >> np.uint64(29))
+    x = x * np.uint64(_MIX_K2)
+    x = x ^ (x >> np.uint64(32))
+    return x.astype(np.int64)
 
 
 def output_schema(input_schema: Schema, group_key, aggregates) -> Schema:
@@ -124,6 +170,13 @@ def delta_contributions(
                 jnp.logical_not(ev.values), nn
             ).astype(jnp.int64) * diff
             cols.append(f)
+            nulls.append(None)
+            cols.append(nn_i)
+            nulls.append(None)
+        elif agg.func.is_basic:
+            v = jnp.where(nn, ev.values.astype(jnp.int64), 0)
+            mixed = jnp.where(nn, _mix64_device(v), 0)
+            cols.append(mixed * diff)
             nulls.append(None)
             cols.append(nn_i)
             nulls.append(None)
@@ -253,6 +306,11 @@ def accums_to_output(
             cols.append(f == 0)
             nulls.append(nn == 0)
             i += 2
+        elif agg.func.is_basic:
+            mix, nn = accum_cols[i], accum_cols[i + 1]
+            cols.append(mix)  # digest placeholder; edge-finalized
+            nulls.append(nn == 0)
+            i += 2
         else:
             raise NotImplementedError(agg.func)
     return cols, nulls
@@ -340,8 +398,9 @@ class ReduceOp:
         from ..plan.decisions import plan_reduce
 
         self.n_key = len(self.group_key)
-        # The accumulable/hierarchical partition comes from the plan
-        # layer so EXPLAIN PHYSICAL PLAN's ReducePlan is what executes.
+        # The accumulable/hierarchical/basic partition comes from the
+        # plan layer so EXPLAIN PHYSICAL PLAN's ReducePlan is what
+        # executes.
         self.plan = plan_reduce(self.aggregates)
         self.acc_aggs = tuple(
             (j, self.aggregates[j]) for j in self.plan.accumulable
@@ -349,24 +408,47 @@ class ReduceOp:
         self.hier_aggs = tuple(
             (j, self.aggregates[j]) for j in self.plan.hierarchical
         )
+        self.basic_aggs = tuple(
+            (j, self.aggregates[j]) for j in self.plan.basic
+        )
+        # Basic aggregates ride the accumulator state with a digest
+        # column pair (change detection) AND keep a sorted (key, value)
+        # multiset part for edge finalization. The accumulator tier
+        # carries acc + basic aggs in ORIGINAL aggregate order.
+        self.acc_like = tuple(
+            (j, a)
+            for j, a in enumerate(self.aggregates)
+            if a.func.is_accumulable or a.func.is_basic
+        )
         self.state_schema = accum_schema(
             self.input_schema,
             self.group_key,
-            tuple(a for _, a in self.acc_aggs),
+            tuple(a for _, a in self.acc_like),
         )
         self.mm_schemas = tuple(
             minmax_state_schema(self.input_schema, self.group_key, a)
             for _, a in self.hier_aggs
         )
+        # Basic multiset parts reuse the min/max multiset layout: a
+        # sorted (key..., value) arrangement with NULL inputs dropped
+        # (string_agg skips NULLs; array_agg follows the reference's
+        # AggregateFunc semantics which also filter nulls,
+        # expr/src/relation/func.rs:1950).
+        self.basic_schemas = tuple(
+            minmax_state_schema(self.input_schema, self.group_key, a)
+            for _, a in self.basic_aggs
+        )
         self.out_schema = output_schema(
             self.input_schema, self.group_key, self.aggregates
         )
-        self.n_parts = 1 + len(self.hier_aggs)
+        self.n_parts = 1 + len(self.hier_aggs) + len(self.basic_aggs)
 
     def init_state(self, capacity: int = 256) -> tuple:
         key = tuple(range(self.n_key))
         parts = [Arrangement.empty(self.state_schema, key, capacity)]
         for sch in self.mm_schemas:
+            parts.append(Arrangement.empty(sch, key, capacity))
+        for sch in self.basic_schemas:
             parts.append(Arrangement.empty(sch, key, capacity))
         return tuple(parts)
 
@@ -376,7 +458,7 @@ class ReduceOp:
         Returns (new_state, output_delta_batch, overflow: dict part->flag).
         """
         acc_state = state[0]
-        acc_aggs = tuple(a for _, a in self.acc_aggs)
+        acc_aggs = tuple(a for _, a in self.acc_like)
         contrib = delta_contributions(
             delta, self.group_key, acc_aggs, self.state_schema, out_time
         )
@@ -414,6 +496,23 @@ class ReduceOp:
             mm_new.append(minmax_query(new_mm, probe_lanes, is_max))
             new_mm_states.append(new_mm)
 
+        # Basic multiset parts: maintain only (no per-step query; the
+        # digest in the accumulator tier detects change, the serving
+        # edge reads these multisets to materialize results).
+        new_basic_states = []
+        base_p = 1 + len(self.hier_aggs)
+        for p, ((j, agg), sch) in enumerate(
+            zip(self.basic_aggs, self.basic_schemas), start=base_p
+        ):
+            b_state = state[p]
+            b_contrib = minmax_contributions(
+                delta, self.group_key, agg, sch, out_time
+            )
+            new_b, overflow[p] = insert(
+                b_state, b_contrib, b_state.capacity
+            )
+            new_basic_states.append(new_b)
+
         # Assemble old/new output rows over ALL aggregates in order.
         key_cols = groups.cols[: self.n_key]
         key_nulls = groups.nulls[: self.n_key]
@@ -428,7 +527,7 @@ class ReduceOp:
             acc_i = self.n_key
             mm_i = 0
             for j, agg in enumerate(self.aggregates):
-                if agg.func.is_accumulable:
+                if agg.func.is_accumulable or agg.func.is_basic:
                     cols.append(acc_cols[acc_i])
                     nulls.append(acc_nulls[acc_i])
                     acc_i += 1
@@ -495,4 +594,8 @@ class ReduceOp:
         )
         out = compact(out, keep)
 
-        return tuple([new_state_acc] + new_mm_states), out, overflow
+        return (
+            tuple([new_state_acc] + new_mm_states + new_basic_states),
+            out,
+            overflow,
+        )

@@ -30,21 +30,30 @@ def plan_reduce(aggregates) -> ReducePlan:
     hier = tuple(
         j for j, a in enumerate(aggregates) if a.func.is_hierarchical
     )
+    basic = tuple(
+        j for j, a in enumerate(aggregates) if a.func.is_basic
+    )
     unsupported = [
         a.func
         for a in aggregates
-        if not (a.func.is_accumulable or a.func.is_hierarchical)
+        if not (
+            a.func.is_accumulable
+            or a.func.is_hierarchical
+            or a.func.is_basic
+        )
     ]
     if unsupported:
         raise NotImplementedError(f"aggregates {unsupported}")
-    if not hier:
+    if not hier and not basic:
         return ReducePlan("Accumulable", acc, ())
-    if not acc:
+    if not acc and not basic:
         # The accumulator part still runs (its __rows__ column is the
         # group-liveness authority), so a pure-min/max reduce is still
         # collated with the implicit count.
         return ReducePlan("Collation", (), hier)
-    return ReducePlan("Collation", acc, hier)
+    if basic and not acc and not hier:
+        return ReducePlan("Basic", (), (), basic)
+    return ReducePlan("Collation", acc, hier, basic)
 
 
 def join_implementation(expr: mir.Join) -> str:

@@ -31,6 +31,10 @@ class ReducePlan:
                      aggregate: retraction repair is a binary search,
                      the TPU re-design of the reference's 16-ary
                      tournament (render/reduce.rs:850).
+      Basic        — collection aggregates (string_agg/array_agg/
+                     list_agg): sorted (key, value) multiset state +
+                     digest accumulator, finalized at the serving edge
+                     (render/reduce.rs:369 build_basic_aggregate).
       Collation    — mix of the above, collated into one output row
                      (render/reduce.rs build_collation).
     """
@@ -38,14 +42,19 @@ class ReducePlan:
     kind: str
     accumulable: tuple = ()  # aggregate positions
     hierarchical: tuple = ()  # aggregate positions
+    basic: tuple = ()  # aggregate positions
 
     def describe(self) -> str:
-        if self.kind in ("Distinct", "Accumulable", "Hierarchical"):
+        if self.kind in ("Distinct", "Accumulable", "Hierarchical",
+                         "Basic"):
             return self.kind
-        return (
-            f"Collation(accumulable={list(self.accumulable)}, "
-            f"hierarchical={list(self.hierarchical)})"
-        )
+        parts = [
+            f"accumulable={list(self.accumulable)}",
+            f"hierarchical={list(self.hierarchical)}",
+        ]
+        if self.basic:
+            parts.append(f"basic={list(self.basic)}")
+        return f"Collation({', '.join(parts)})"
 
 
 @dataclass(frozen=True)

@@ -107,6 +107,11 @@ class _RenderContext:
         # "rows advanced to as_of") — not at literal time 0, which a
         # hydrated dataflow never processes.
         self.first_time = 0
+        # Reduce sites with basic (collection) aggregates: (mir node id,
+        # state slot, ReduceOp). The dataflow resolves these against its
+        # top-level expression to build edge finalizers (ops/reduce.py
+        # basic tier — render/reduce.rs:369 analog).
+        self.basic_sites: list = []
 
     @property
     def sharded(self) -> bool:
@@ -308,6 +313,8 @@ def _build(expr: mir.RelationExpr, ctx: _RenderContext):
             expr.input.schema(), expr.group_key, expr.aggregates
         )
         slot = ctx.new_slot(op, op.init_state(ctx.state_cap))
+        if op.basic_aggs:
+            ctx.basic_sites.append((id(expr), slot, op))
         site = ctx.new_exchange_site()
         inner = _build(expr.input, ctx)
         group_key = expr.group_key
@@ -715,6 +722,120 @@ def _build_letrec(expr: mir.LetRec, ctx: _RenderContext):
         return out, upd, ovf_out
 
     return run
+
+
+
+def _scalar_col_refs(e, out: set) -> None:
+    from ..expr import scalar as ms
+
+    if isinstance(e, ms.ColumnRef):
+        out.add(e.index)
+        return
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        if isinstance(v, ms.ScalarExpr):
+            _scalar_col_refs(v, out)
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, ms.ScalarExpr):
+                    _scalar_col_refs(x, out)
+
+
+def _resolve_basic_sites(expr: mir.RelationExpr, ctx) -> list:
+    """Resolve basic-aggregate Reduce sites against the dataflow's
+    top-level expression.
+
+    A basic aggregate's device column is an opaque digest; its real
+    (variable-width) value exists only at the serving edge. The digest
+    may flow to the output through Project/Map/Filter layers that do
+    not COMPUTE on it; anything else would leak digests into real
+    operators, so it raises. Returns
+    [(output col, state slot, state part, AggregateExpr, value Column)].
+    """
+    if not ctx.basic_sites:
+        return []
+    chain = []
+    node = expr
+    while isinstance(node, (mir.Project, mir.Map, mir.Filter)):
+        chain.append(node)
+        node = node.input
+    sites = {nid: (slot, op) for (nid, slot, op) in ctx.basic_sites}
+    finalizers: list = []
+    if id(node) in sites:
+        slot, op = sites.pop(id(node))
+        pos: dict = {}
+        for b, (j, agg) in enumerate(op.basic_aggs):
+            part = 1 + len(op.hier_aggs) + b
+            vcol = agg.expr.typ(op.input_schema)
+            pos[op.n_key + j] = (slot, part, agg, vcol)
+        for layer in reversed(chain):
+            if isinstance(layer, (mir.Map, mir.Filter)):
+                exprs = (
+                    layer.scalars
+                    if isinstance(layer, mir.Map)
+                    else layer.predicates
+                )
+                refs: set = set()
+                for e in exprs:
+                    _scalar_col_refs(e, refs)
+                if refs & set(pos):
+                    raise NotImplementedError(
+                        "string_agg/array_agg/list_agg results cannot "
+                        "feed scalar expressions or filters: the "
+                        "maintained device column is a digest, "
+                        "finalized only at the serving edge"
+                    )
+            else:  # Project
+                pos = {
+                    o: pos[srcidx]
+                    for o, srcidx in enumerate(layer.outputs)
+                    if srcidx in pos
+                }
+        finalizers = [(o, *v) for o, v in pos.items()]
+    if sites:
+        raise NotImplementedError(
+            "string_agg/array_agg/list_agg must sit at the dataflow "
+            "output (optionally under Project/Map/Filter); composing "
+            "them into joins, further reduces, or other operators is "
+            "not supported"
+        )
+    return finalizers
+
+
+def _finalize_basic_value(agg, vcol, values, mults) -> str:
+    """Materialize one group's basic-aggregate result from its sorted
+    multiset (host side)."""
+    from ..expr.relation import AggregateFunc
+    from ..repr.schema import GLOBAL_DICT, ColumnType
+
+    def render(v) -> str:
+        if vcol.ctype is ColumnType.STRING:
+            return GLOBAL_DICT.decode(int(v))
+        if vcol.ctype is ColumnType.BOOL:
+            return "t" if v else "f"
+        if vcol.ctype is ColumnType.DECIMAL and vcol.scale:
+            q = 10 ** vcol.scale
+            sign = "-" if v < 0 else ""
+            v = abs(int(v))
+            return f"{sign}{v // q}.{v % q:0{vcol.scale}d}"
+        if vcol.ctype is ColumnType.DATE:
+            from ..repr.schema import days_to_date
+
+            return str(days_to_date(v))
+        if vcol.ctype is ColumnType.TIMESTAMP:
+            from ..repr.schema import ms_to_ts
+
+            return str(ms_to_ts(v))
+        return str(int(v))
+
+    parts: list = []
+    for v, m in zip(values, mults):
+        parts.extend([render(v)] * int(m))
+    if agg.func is AggregateFunc.STRING_AGG:
+        sep = agg.params[0] if agg.params else ""
+        return sep.join(parts)
+    return "{" + ",".join(parts) + "}"
+
 
 
 class _DataflowBase:
@@ -1152,6 +1273,7 @@ class Dataflow(_DataflowBase):
         ctx = _RenderContext({}, state_cap=state_cap)
         self._run = _build(expr, ctx)
         self._ctx = ctx
+        self._basic_finalizers = _resolve_basic_sites(expr, ctx)
         self.states = [s.init for s in ctx.slots]
         self._init_output()
         self.time = 0  # frontier: all steps < time are complete
@@ -1226,7 +1348,93 @@ class Dataflow(_DataflowBase):
 
     def peek(self) -> list[tuple]:
         """Read the full maintained result (SELECT * FROM mv)."""
-        return self.output_batch().to_rows()
+        b = self.output_batch()
+        if not self._basic_finalizers:
+            return b.to_rows()
+        n = int(b.count)
+        cols = [np.asarray(c)[:n] for c in b.cols]
+        nulls = [
+            None if x is None else np.asarray(x)[:n] for x in b.nulls
+        ]
+        cols = self.finalize_basic_columns(cols, nulls)
+        cols = cols + [
+            np.asarray(b.time)[:n], np.asarray(b.diff)[:n]
+        ]
+        return [tuple(x.item() for x in row) for row in zip(*cols)]
+
+    def finalize_basic_columns(self, cols, nulls) -> list:
+        """Edge finalization of basic aggregates (render/reduce.rs:369
+        analog): replace each digest value in the host output columns
+        with the dictionary code of the group's materialized result,
+        computed from the maintained (key, value) multiset state. The
+        digest<->group association needs no key matching: equal digests
+        imply equal multisets (splitmix64 sum), which imply equal
+        results."""
+        if not self._basic_finalizers:
+            return list(cols)
+        from ..ops.reduce import _mix64_host
+        from ..repr.schema import GLOBAL_DICT
+
+        cols = list(cols)
+        for (out_col, slot, part, agg, vcol) in self._basic_finalizers:
+            arr = self.states[slot][part]
+            b = arr.batch
+            n = int(b.count)
+            bcols = [np.asarray(c)[:n] for c in b.cols]
+            bnulls = [
+                None if x is None else np.asarray(x)[:n]
+                for x in b.nulls
+            ]
+            diffs = np.asarray(b.diff)[:n]
+            keep = diffs != 0
+            n_key = len(arr.key)
+            vals = bcols[n_key][keep].astype(np.int64)
+            mult = diffs[keep]
+            table: dict = {}
+            if len(vals):
+                # Group boundaries: multiset rows sort by (key, value)
+                # with NULL keys canonicalized first, so groups are
+                # contiguous; compare raw values gated on null flags.
+                change = np.zeros(len(vals), dtype=bool)
+                change[0] = True
+                for ki in range(n_key):
+                    kc = bcols[ki][keep]
+                    nl = bnulls[ki]
+                    if nl is None:
+                        change[1:] |= kc[1:] != kc[:-1]
+                    else:
+                        nl = nl[keep]
+                        both = ~nl[1:] & ~nl[:-1]
+                        change[1:] |= (nl[1:] != nl[:-1]) | (
+                            both & (kc[1:] != kc[:-1])
+                        )
+                starts = np.flatnonzero(change)
+                ends = np.append(starts[1:], len(vals))
+                m = _mix64_host(vals).astype(np.uint64) * mult.astype(
+                    np.uint64
+                )
+                for s0, e0 in zip(starts, ends):
+                    dig = int(
+                        m[s0:e0].sum(dtype=np.uint64).astype(np.int64)
+                    )
+                    res = _finalize_basic_value(
+                        agg, vcol, vals[s0:e0], mult[s0:e0]
+                    )
+                    table[dig] = GLOBAL_DICT.encode(res)
+            col = np.asarray(cols[out_col]).copy()
+            nl = nulls[out_col] if nulls else None
+            for i in range(len(col)):
+                if nl is not None and nl[i]:
+                    continue
+                d = int(col[i])
+                if d not in table:
+                    raise RuntimeError(
+                        "basic-aggregate digest has no multiset group "
+                        "(digest/multiset divergence)"
+                    )
+                col[i] = table[d]
+            cols[out_col] = col
+        return cols
 
     def peek_errors(self) -> list[tuple]:
         """The maintained err collection: [(err_code, count)] with
@@ -1292,6 +1500,13 @@ class ShardedDataflow(_DataflowBase):
             slot_cap=slot_cap, state_cap=state_cap,
         )
         self._run = _build(expr, ctx)
+        if ctx.basic_sites:
+            raise NotImplementedError(
+                "basic aggregates (string_agg/array_agg/list_agg) are "
+                "not yet supported on sharded dataflows: edge "
+                "finalization reads the single-device multiset state"
+            )
+        self._basic_finalizers = []
         self._ctx = ctx
         self.input_shard_cap = input_shard_cap
         self._sharding = worker_sharding(mesh, self.axis_name)

@@ -127,6 +127,14 @@ class Schema:
         )
 
 
+class DictExhausted(RuntimeError):
+    """A gap in the label space ran out between two dense neighbors.
+    Recoverable: callers that own device-resident state catch this,
+    call ``rebalance()``, remap host-side literals, and rebuild their
+    dataflows (durable state is safe — persist parts store the actual
+    strings, storage/persist/codec.py)."""
+
+
 class StringDictionary:
     """Host-side string dictionary: str <-> ORDER-PRESERVING int64 code.
 
@@ -155,10 +163,60 @@ class StringDictionary:
     MAX_LABEL = 1 << 62
 
     def __init__(self):
+        import threading
+
         self._sorted: list[str] = []  # lexicographically sorted
         self._codes: dict[str, int] = {}
         self._by_code: dict[int, str] = {}
         self.version = 0  # bumped on every insert (env-cache key)
+        # Relabeling epoch: bumped by rebalance(). Every holder of codes
+        # OUTSIDE this object (env caches, device arrangements, MIR
+        # literals) must treat a changed epoch as total invalidation.
+        self.epoch = 0
+        self._lock = threading.RLock()
+        # Process-wide recovery hooks: called (with the old->new remap)
+        # inside rebalance() so in-process holders of codes (controller
+        # command history, replica dataflows) can remap/rebuild.
+        self._listeners: list = []
+
+    def add_rebalance_listener(self, fn) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+    def remove_rebalance_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def rebalance(self) -> dict:
+        """Reassign every code evenly across the label space (order
+        preserved) after gap exhaustion. Returns the {old: new} remap.
+
+        Device-resident arrangements and host caches keyed by code
+        become garbage: registered listeners fire synchronously (under
+        the lock) so the process's holders can remap host literals and
+        schedule dataflow rebuilds. Cross-PROCESS holders (a remote
+        replica's own dictionary) are not reached — the separate-process
+        replica path keeps its own dictionary and must hit its own
+        exhaustion independently (documented limitation, L7)."""
+        with self._lock:
+            n = len(self._sorted)
+            span = self.MAX_LABEL - self.MIN_LABEL
+            remap: dict[int, int] = {}
+            new_codes: dict[str, int] = {}
+            new_by_code: dict[int, str] = {}
+            for i, s in enumerate(self._sorted):
+                new = self.MIN_LABEL + (i + 1) * span // (n + 1)
+                remap[self._codes[s]] = new
+                new_codes[s] = new
+                new_by_code[new] = s
+            self._codes = new_codes
+            self._by_code = new_by_code
+            self.version += 1
+            self.epoch += 1
+            for fn in list(self._listeners):
+                fn(remap)
+            return remap
 
     @staticmethod
     def _frac(lo_s: str | None, hi_s: str | None, s: str) -> float:
@@ -192,27 +250,99 @@ class StringDictionary:
             return code
         import bisect
 
-        i = bisect.bisect_left(self._sorted, s)
-        lo_s = self._sorted[i - 1] if i > 0 else None
-        hi_s = self._sorted[i] if i < len(self._sorted) else None
-        lo = self._codes[lo_s] if lo_s is not None else self.MIN_LABEL
-        hi = self._codes[hi_s] if hi_s is not None else self.MAX_LABEL
-        gap = hi - lo
-        if gap < 2:
-            raise RuntimeError(
-                "string dictionary label space exhausted between "
-                f"{lo_s!r} and {hi_s!r}"
-            )
-        f = self._frac(lo_s, hi_s, s)
-        code = lo + max(1, min(gap - 1, int(gap * f)))
-        self._sorted.insert(i, s)
-        self._codes[s] = code
-        self._by_code[code] = s
-        self.version += 1
-        return code
+        with self._lock:
+            code = self._codes.get(s)
+            if code is not None:
+                return code
+            i = bisect.bisect_left(self._sorted, s)
+            lo_s = self._sorted[i - 1] if i > 0 else None
+            hi_s = self._sorted[i] if i < len(self._sorted) else None
+            lo = self._codes[lo_s] if lo_s is not None else self.MIN_LABEL
+            hi = self._codes[hi_s] if hi_s is not None else self.MAX_LABEL
+            gap = hi - lo
+            if gap < 2:
+                raise DictExhausted(
+                    "string dictionary label space exhausted between "
+                    f"{lo_s!r} and {hi_s!r}"
+                )
+            f = self._frac(lo_s, hi_s, s)
+            code = lo + max(1, min(gap - 1, int(gap * f)))
+            self._sorted.insert(i, s)
+            self._codes[s] = code
+            self._by_code[code] = s
+            self.version += 1
+            return code
 
     def encode_many(self, strings) -> np.ndarray:
         return np.asarray([self.encode(s) for s in strings], dtype=np.int64)
+
+    def encode_bulk(self, strings) -> None:
+        """Insert a SET of new strings with positional gap division.
+
+        Content interpolation (encode) fundamentally mislabels
+        long-common-prefix families: the whole family maps to a tiny
+        content interval, so one-at-a-time inserts pack its members into
+        a sliver of the gap regardless of how many there are (observed:
+        case-mapped catalog JSON families driving gaps to 1). A bulk
+        insert knows every member up front, so each run of new strings
+        falling between two existing neighbors divides that gap EVENLY
+        by position — 10^6 strings in one gap get even spacing. Env
+        table builds (the dominant dense-insert source) use this."""
+        import bisect
+
+        with self._lock:
+            new = sorted(
+                {s for s in strings if s not in self._codes}
+            )
+            if not new:
+                return
+            # Group the new strings into runs per existing-neighbor gap.
+            runs: list[tuple[int, int, list[str]]] = []
+            k = 0
+            while k < len(new):
+                i = bisect.bisect_left(self._sorted, new[k])
+                hi_s = (
+                    self._sorted[i] if i < len(self._sorted) else None
+                )
+                lo = (
+                    self._codes[self._sorted[i - 1]]
+                    if i > 0
+                    else self.MIN_LABEL
+                )
+                hi = (
+                    self._codes[hi_s]
+                    if hi_s is not None
+                    else self.MAX_LABEL
+                )
+                run = [new[k]]
+                k += 1
+                while k < len(new) and (
+                    hi_s is None or new[k] < hi_s
+                ):
+                    run.append(new[k])
+                    k += 1
+                runs.append((lo, hi, run))
+            # Validate EVERY run before mutating anything: a partial
+            # insert would leave _sorted stale against _codes, and a
+            # concurrent encode() could then hand out an already-taken
+            # label (exception atomicity).
+            for lo, hi, run in runs:
+                if hi - lo <= len(run):
+                    raise DictExhausted(
+                        f"bulk insert of {len(run)} strings does not "
+                        f"fit in gap {hi - lo} at {run[0]!r}"
+                    )
+            for lo, hi, run in runs:
+                gap = hi - lo
+                for j, s in enumerate(run, 1):
+                    # Even division guarantees uniqueness within the
+                    # run; lo/hi are exclusive.
+                    code = lo + j * gap // (len(run) + 1)
+                    self._codes[s] = code
+                    self._by_code[code] = s
+            # One sorted rebuild instead of n insorts.
+            self._sorted = sorted(self._codes)
+            self.version += 1
 
     def decode(self, code: int) -> str:
         return self._by_code[int(code)]
@@ -232,6 +362,35 @@ class StringDictionary:
 # overkill for now: a single shared dictionary per process is correct (codes
 # are only compared for equality) and keeps joins on string columns trivial.
 GLOBAL_DICT = StringDictionary()
+
+
+_EPOCH_DATE = None  # lazy datetime import
+
+
+def days_to_date(days: int):
+    import datetime as _dt
+
+    return _dt.date(1970, 1, 1) + _dt.timedelta(days=int(days))
+
+
+def date_to_days(d) -> int:
+    import datetime as _dt
+
+    return (d - _dt.date(1970, 1, 1)).days
+
+
+def ms_to_ts(ms: int):
+    import datetime as _dt
+
+    return _dt.datetime(1970, 1, 1) + _dt.timedelta(
+        milliseconds=int(ms)
+    )
+
+
+def ts_to_ms(ts) -> int:
+    import datetime as _dt
+
+    return int((ts - _dt.datetime(1970, 1, 1)).total_seconds() * 1000)
 
 
 def decode_result_rows(schema: Schema, cols, nulls, time, diff) -> list:
@@ -257,6 +416,10 @@ def decode_result_rows(schema: Schema, cols, nulls, time, diff) -> list:
                     _dec.Decimal(int(cols[j][i]))
                     / (10 ** col.scale)
                 )
+            elif col.ctype is ColumnType.DATE:
+                vals.append(days_to_date(cols[j][i]))
+            elif col.ctype is ColumnType.TIMESTAMP:
+                vals.append(ms_to_ts(cols[j][i]))
             else:
                 vals.append(cols[j][i].item())
         out.append(tuple(vals) + (int(time[i]), int(diff[i])))

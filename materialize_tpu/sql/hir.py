@@ -198,6 +198,8 @@ class HAggregate:
     expr: HirScalar
     distinct: bool
     out: Column
+    # Host-side plan parameters (string_agg separator).
+    params: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -302,6 +304,11 @@ class ScopeItem:
     # copy stays addressable by qualified name but is skipped by
     # unqualified lookup and bare `*` (pg join-USING scope semantics).
     hidden: bool = False
+    # pg emits USING-merged columns FIRST in unqualified `*` expansion
+    # (outermost join's columns first, then USING-clause order). Items
+    # with a star_rank sort ascending before unranked items, which keep
+    # positional order.
+    star_rank: Optional[int] = None
 
 
 @dataclass

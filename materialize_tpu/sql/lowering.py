@@ -145,7 +145,7 @@ def _lower_reduce(inner, rel: h.HReduce, shift: int, cmap: dict):
         inner, [a.expr for a in rel.aggregates], shift=shift, cmap=cmap
     )
     aggs = tuple(
-        AggregateExpr(a.func, e, a.distinct)
+        AggregateExpr(a.func, e, a.distinct, getattr(a, "params", ()))
         for a, e in zip(rel.aggregates, agg_exprs)
     )
     gk = tuple(range(shift)) + tuple(shift + i for i in rel.group_key)

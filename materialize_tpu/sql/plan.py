@@ -262,6 +262,32 @@ def _table_schema(columns) -> Schema:
     return Schema(cols)
 
 
+def _eval_insert_value(e: ast.Expr, col):
+    """INSERT literal coerced to the target column: string literals
+    parse per the column type (DATE '1994-01-01', decimal text), and
+    CAST(lit AS type) / typed literals evaluate at plan time — the same
+    coercions the COPY text path applies (parse_text_value)."""
+    from ..repr.schema import Column, ColumnType, parse_text_value
+    from .hir import parse_type
+
+    if isinstance(e, ast.Cast):
+        ty, scale = parse_type(e.to_type)
+        v = _eval_insert_value(
+            e.expr, Column(col.name, ty, True, scale)
+        )
+        if v is None:
+            return None
+        # Re-coerce into the DESTINATION column when the cast type
+        # differs: a text-valued cast result parses per the column
+        # (CAST('1994-01-01' AS text) into a date column).
+        if ty != col.ctype and isinstance(v, str):
+            return parse_text_value(v, col)
+        return v
+    if isinstance(e, ast.StringLit) and col.ctype is not ColumnType.STRING:
+        return parse_text_value(e.value, col)
+    return _eval_literal(e)
+
+
 def _eval_literal(e: ast.Expr):
     if isinstance(e, ast.NumberLit):
         return float(e.text) if "." in e.text or "e" in e.text.lower() \
@@ -348,7 +374,7 @@ def _plan_insert(stmt: ast.Insert, catalog: CatalogInterface) -> Plan:
             )
         full = [None] * len(names)
         for slot, e in zip(order, r):
-            full[slot] = _eval_literal(e)
+            full[slot] = _eval_insert_value(e, schema.columns[slot])
         for i, col in enumerate(schema.columns):
             if full[i] is None and not col.nullable:
                 raise PlanError(

@@ -137,9 +137,11 @@ _VAR_AGGS = {
     "var_samp",
     "var_pop",
 }
+_BASIC_AGGS = {"string_agg", "array_agg", "list_agg"}
 _AGG_FUNCS = (
     {"count", "sum", "min", "max", "avg", "bool_and", "bool_or", "every"}
     | _VAR_AGGS
+    | _BASIC_AGGS
 )
 
 
@@ -350,7 +352,14 @@ class QueryPlanner:
                     larity = len(s.items)
                     from .hir import ScopeItem as _SI
 
-                    for name in jc.using:
+                    # pg `*` order: USING-merged columns first, outermost
+                    # join first. Later joins get smaller (more negative)
+                    # rank bases so their merged columns sort ahead.
+                    self._using_join_seq = getattr(
+                        self, "_using_join_seq", 0
+                    ) + 1
+                    rank_base = -(self._using_join_seq << 16)
+                    for uidx, name in enumerate(jc.using):
                         li = s.resolve((name,))
                         ri = js.resolve((name,))
                         on.append(
@@ -374,9 +383,17 @@ class QueryPlanner:
                         hide = (
                             li if jc.kind == "right" else larity + ri
                         )
+                        keep = (
+                            larity + ri if jc.kind == "right" else li
+                        )
                         it = combined.items[hide]
                         combined.items[hide] = _SI(
                             it.table, it.name, hidden=True
+                        )
+                        kt = combined.items[keep]
+                        combined.items[keep] = _SI(
+                            kt.table, kt.name, hidden=kt.hidden,
+                            star_rank=rank_base + uidx,
                         )
                 elif jc.on is not None:
                     on = self._conjuncts(jc.on, combined)
@@ -411,7 +428,21 @@ class QueryPlanner:
         items: list[tuple[ast.Expr, str]] = []
         for it in sel.items:
             if isinstance(it.expr, ast.Star):
-                for i, sc in enumerate(scope.items):
+                # pg column order for unqualified `*` over USING joins:
+                # merged join columns first (outermost join first, then
+                # USING-clause order), remaining columns positionally.
+                expand = [
+                    (i, sc) for i, sc in enumerate(scope.items)
+                ]
+                if not it.expr.qualifier:
+                    expand.sort(
+                        key=lambda t: (
+                            t[1].star_rank
+                            if t[1].star_rank is not None
+                            else t[0]
+                        )
+                    )
+                for i, sc in expand:
                     if it.expr.qualifier and sc.table != it.expr.qualifier:
                         continue
                     if not it.expr.qualifier and sc.hidden:
@@ -540,6 +571,49 @@ class QueryPlanner:
                 )
                 out = Column(name, ColumnType.BOOL, True)
                 aggs.append(HAggregate(func, inner, False, out))
+                return ("plain", [len(aggs) - 1])
+            if name in _BASIC_AGGS:
+                # Basic (collection) aggregates: maintained as a sorted
+                # (key, value) multiset + change digest on device,
+                # materialized at the serving edge (ops/reduce.py;
+                # render/reduce.rs:369 build_basic_aggregate analog).
+                if dist:
+                    raise PlanError(
+                        f"{name}(DISTINCT ...) is not supported"
+                    )
+                params: tuple = ()
+                if name == "string_agg":
+                    if len(fc.args) != 2:
+                        raise PlanError(
+                            "string_agg requires (value, separator)"
+                        )
+                    sep_ast = fc.args[1]
+                    if not isinstance(sep_ast, ast.StringLit):
+                        raise PlanError(
+                            "string_agg separator must be a string "
+                            "literal"
+                        )
+                    params = (sep_ast.value,)
+                    if ityp.ctype is not ColumnType.STRING:
+                        raise PlanError(
+                            "string_agg requires a text argument"
+                        )
+                    func = AggregateFunc.STRING_AGG
+                else:
+                    if ityp.ctype is ColumnType.FLOAT64:
+                        raise PlanError(
+                            f"{name} over double precision is not "
+                            "supported yet (int64-lane values only)"
+                        )
+                    func = (
+                        AggregateFunc.ARRAY_AGG
+                        if name == "array_agg"
+                        else AggregateFunc.LIST_AGG
+                    )
+                out = Column(name, ColumnType.STRING, True)
+                aggs.append(
+                    HAggregate(func, inner, False, out, params)
+                )
                 return ("plain", [len(aggs) - 1])
             if name == "avg":
                 _, s = plan_agg(

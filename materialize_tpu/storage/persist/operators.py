@@ -164,6 +164,16 @@ class IndexSource:
                 s.publisher._subscribers.remove(s)
 
     def __init__(self, publisher: "MaintainedView", schema: Schema):
+        if getattr(publisher.df, "_basic_finalizers", None):
+            # The publisher's arrangement carries opaque basic-aggregate
+            # digests; a subscriber could never finalize them. The
+            # coordinator inlines such views instead of index-importing
+            # (coordinator._inline_views); this guard catches direct
+            # users.
+            raise ValueError(
+                "an index over basic aggregates (string_agg/array_agg/"
+                "list_agg) cannot be imported by other dataflows"
+            )
         self.publisher = publisher
         self.schema = schema
         self.reader = IndexSource._Reader(self)
@@ -321,6 +331,14 @@ class MaintainedView:
         self.client = client
         self.replica_id = replica_id
         self.df = dataflow
+        if output_shard and getattr(dataflow, "_basic_finalizers", None):
+            # The sink would persist opaque digests; readers of the
+            # shard could never finalize them (the multiset lives on
+            # this replica's device). INDEX/SELECT serve these fine.
+            raise ValueError(
+                "string_agg/array_agg/list_agg cannot be persisted in "
+                "a MATERIALIZED VIEW yet; use a VIEW, INDEX, or SELECT"
+            )
         self._subscribers: list = []
         self.sources = {
             name: ShardSource(client.open_reader(shard), schema)

@@ -338,7 +338,15 @@ def _fold_scalar(e: ms.ScalarExpr) -> ms.ScalarExpr:
                 and l.scale == 0
                 and r.scale == 0
             ):
-                return ms.Literal(arith[f](lv, rv), l.ctype)
+                # Wrap to int64 so folded constants match the device's
+                # wrapping arithmetic (unbounded Python ints would
+                # diverge on overflow, and could not materialize).
+                v = arith[f](lv, rv)
+                if l.ctype is ColumnType.INT32:
+                    v = ((v + (1 << 31)) % (1 << 32)) - (1 << 31)
+                elif l.ctype is not ColumnType.FLOAT64:
+                    v = ((v + (1 << 63)) % (1 << 64)) - (1 << 63)
+                return ms.Literal(v, l.ctype)
             if (
                 f in arith
                 and l.ctype == r.ctype
@@ -570,7 +578,7 @@ def plan_distinct_aggregates(expr: mir.RelationExpr) -> mir.RelationExpr:
         if not any(a.distinct for a in e.aggregates):
             return e
         aggs = [
-            AggregateExpr(a.func, a.expr, False)
+            AggregateExpr(a.func, a.expr, False, a.params)
             if a.distinct
             and a.func in (AggregateFunc.MIN, AggregateFunc.MAX)
             else a
@@ -656,7 +664,9 @@ def plan_distinct_aggregates(expr: mir.RelationExpr) -> mir.RelationExpr:
                 dedup,
                 tuple(range(nk)),
                 tuple(
-                    AggregateExpr(a.func, ms.ColumnRef(nk), False)
+                    AggregateExpr(
+                        a.func, ms.ColumnRef(nk), False, a.params
+                    )
                     for _, a in lst
                 ),
             )
@@ -695,6 +705,27 @@ def plan_distinct_aggregates(expr: mir.RelationExpr) -> mir.RelationExpr:
         )
 
     return _bottom_up(expr, rw)
+
+
+def _lit_class_unsat(lits) -> bool:
+    """True when an equivalence class of literals cannot be satisfied:
+    any NULL member (SQL NULL = x is never true, so the join is empty —
+    NOT a cross product) or two numerically distinct values. Decimal
+    literals compare by scaled value so 1.50 (scale 2) == 1.5 (scale 1);
+    string literals compare by dictionary code (code equality == string
+    equality)."""
+    from fractions import Fraction
+
+    if any(l.value is None for l in lits):
+        return True
+
+    def norm(l):
+        if l.scale:
+            return Fraction(int(l.value), 10**l.scale)
+        return l.value
+
+    first = norm(lits[0])
+    return any(norm(l) != first for l in lits[1:])
 
 
 def canonicalize_join_equivalences(
@@ -757,8 +788,8 @@ def canonicalize_join_equivalences(
                 # col = literal: a local filter on every owning input;
                 # the class collapses entirely (all members equal the
                 # literal, transitively local).
-                if any(l.value != lits[0].value for l in lits[1:]):
-                    return mir.Constant((), e.schema())  # lit1 = lit2 false
+                if _lit_class_unsat(lits):
+                    return mir.Constant((), e.schema())  # unsatisfiable
                 lit = lits[0]
                 changed = True
                 for j, local in cols.items():
@@ -911,6 +942,11 @@ def redundant_join(expr: mir.RelationExpr) -> mir.RelationExpr:
                     lit_members.append(lit_for(m.index))
                 else:
                     kept_members.append(m)
+            if lit_members and _lit_class_unsat(lit_members):
+                # A class whose victim-constant members are NULL or
+                # mutually distinct can never be satisfied: the join is
+                # empty, not unconstrained.
+                return mir.Constant((), e.schema())
             if lit_members and kept_members:
                 for m in kept_members:
                     shifted = _shift_scalar(m, mapping)
@@ -986,7 +1022,9 @@ def projection_pushdown(expr: mir.RelationExpr) -> mir.RelationExpr:
                     if sh is None:
                         ok = False
                         break
-                    aggs.append(AggregateExpr(a.func, sh, a.distinct))
+                    aggs.append(
+                        AggregateExpr(a.func, sh, a.distinct, a.params)
+                    )
                 if ok:
                     return mir.Reduce(
                         mir.Project(e.input, tuple(keep)),

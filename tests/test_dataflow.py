@@ -293,3 +293,63 @@ class TestMinMaxReduce:
         b2 = _mk_batch(schema, [np.array([3, 3, 3])], [-1, -1, -1], time=1)
         df.step({"in": b2})
         assert sorted(r[0] for r in df.peek()) == [1, 2]
+
+
+class TestNestedStringCalls:
+    """Regression: self-nested same-key string calls must see their own
+    results. The _EnvCache used to stamp the POST-build dictionary
+    version, so a build that grew the dictionary (encoding 'str'-kind
+    results) was treated as current and the next depth pass gathered
+    garbage (upper(upper('foo')) evaluated to an unrelated string)."""
+
+    def _eval_unary(self, make_expr, strs):
+        from materialize_tpu.expr import scalar as ms
+        from materialize_tpu.repr.schema import GLOBAL_DICT
+
+        schema = Schema([Column("s", ColumnType.STRING)])
+        codes = np.array(
+            [GLOBAL_DICT.encode(x) for x in strs], np.int64
+        )
+        expr = mir.Project(
+            mir.Map(mir.Get("st", schema), (make_expr(ms),)), (1,)
+        )
+        df = Dataflow(expr, state_cap=256)
+        df.step({"st": _mk_batch(schema, [codes], [1] * len(strs))})
+        return sorted(
+            GLOBAL_DICT.decode(int(r[0])) for r in df.peek()
+        )
+
+    def test_upper_upper(self):
+        got = self._eval_unary(
+            lambda ms: ms.string_call(
+                "upper", ms.string_call("upper", ms.ColumnRef(0))
+            ),
+            ["foo", "bar", "apple"],
+        )
+        assert got == ["APPLE", "BAR", "FOO"]
+
+    def test_trim_trim(self):
+        got = self._eval_unary(
+            lambda ms: ms.string_call(
+                "trim", ms.string_call("trim", ms.ColumnRef(0))
+            ),
+            ["  padded  ", "x"],
+        )
+        assert got == ["padded", "x"]
+
+    def test_concat_chain(self):
+        from materialize_tpu.expr.scalar import Literal
+        from materialize_tpu.repr.schema import GLOBAL_DICT
+
+        lit_a = Literal(
+            GLOBAL_DICT.encode("a"), ColumnType.STRING
+        )
+        got = self._eval_unary(
+            lambda ms: ms.string_call(
+                "concat_r",
+                ms.string_call("concat_r", ms.ColumnRef(0), lit_a),
+                lit_a,
+            ),
+            ["z", "q"],
+        )
+        assert got == ["qaa", "zaa"]
