@@ -683,12 +683,12 @@ class Coordinator:
         return len(rows)
 
     def _group_commit(self, table: str, cols, nulls, diffs) -> int:
-        self._net_durable += 1
         """Group commit on the shared table timeline (coord/appends.rs
         + txn-wal): allocate one write timestamp past every table
         upper, write the target table, advance all other tables to the
         same upper with empty appends, then apply the write to the
         oracle. The ONE place the table-timeline protocol lives."""
+        self._net_durable += 1
         at_least = max(
             (w.upper for w in self._table_writers.values()), default=0
         )

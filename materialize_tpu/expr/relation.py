@@ -67,6 +67,14 @@ class AggregateFunc(enum.Enum):
             AggregateFunc.LIST_AGG,
         )
 
+    @property
+    def preserves_nulls(self) -> bool:
+        """array_agg/list_agg keep NULL elements (pg semantics; the
+        reference's SQL layer wraps each value in ArrayCreate before
+        ArrayConcat so NULLs survive, sql/src/func.rs:3668).
+        string_agg drops them."""
+        return self in (AggregateFunc.ARRAY_AGG, AggregateFunc.LIST_AGG)
+
 
 @dataclass(frozen=True)
 class AggregateExpr:

@@ -196,6 +196,26 @@ class _EnvCache:
         self._epoch = 0
 
     def table(self, key: str) -> tuple:
+        # Optimistic epoch validation: the build runs UNLOCKED (it can
+        # hold O(dict) Python regex work — taking the dictionary lock
+        # for its duration would stall every concurrent decode/encode),
+        # then re-checks the epoch under the lock. A rebalance that
+        # interleaved with the build (epoch moved) would have produced
+        # tables mixing old and new labels against device arrays still
+        # holding old codes (garbage gathers) — those are discarded and
+        # the build retried under the new labeling.
+        while True:
+            built = self._table_once(key)
+            with GLOBAL_DICT.lock():
+                if self._epoch == GLOBAL_DICT.epoch:
+                    return built
+            # epoch moved mid-build: reset and retry
+            self._tables.clear()
+            self._version.clear()
+            self._done.clear()
+            self._epoch = GLOBAL_DICT.epoch
+
+    def _table_once(self, key: str) -> tuple:
         # A rebalance relabeled every code: tables (label arrays) and
         # done maps (keyed by label, str-kind values are labels too)
         # are all garbage. Full reset.

@@ -95,6 +95,14 @@ _MIX_K2 = 0x94D049BB133111EB
 # ({0} ∪ S and S would collide). XOR a nonzero constant first.
 _MIX_PRE = 0xA5A5A5A5A5A5A5A5
 
+# Digest contribution of a NULL element in a NULL-preserving basic
+# aggregate (array_agg/list_agg keep NULL elements, pg semantics; the
+# reference's SQL layer wraps values in ArrayCreate before ArrayConcat
+# for the same reason, sql/src/func.rs:3668). A fixed random 64-bit
+# constant outside splitmix64's image of any small value; collision risk
+# is the same class as value-digest collisions generally.
+_NULL_DIGEST = -0x6512BD43D9CAA6E1  # int64
+
 
 def _mix64_device(v: jnp.ndarray) -> jnp.ndarray:
     x = v.astype(jnp.uint64) ^ jnp.uint64(_MIX_PRE)
@@ -175,11 +183,24 @@ def delta_contributions(
             nulls.append(None)
         elif agg.func.is_basic:
             v = jnp.where(nn, ev.values.astype(jnp.int64), 0)
-            mixed = jnp.where(nn, _mix64_device(v), 0)
-            cols.append(mixed * diff)
-            nulls.append(None)
-            cols.append(nn_i)
-            nulls.append(None)
+            if agg.func.preserves_nulls:
+                # array_agg/list_agg: NULL elements are kept — they
+                # contribute a fixed marker to the digest, and the
+                # element count (nn lane) counts EVERY element so the
+                # result is NULL only for an element-less group.
+                mixed = jnp.where(
+                    nn, _mix64_device(v), jnp.int64(_NULL_DIGEST)
+                )
+                cols.append(mixed * diff)
+                nulls.append(None)
+                cols.append(diff)
+                nulls.append(None)
+            else:
+                mixed = jnp.where(nn, _mix64_device(v), 0)
+                cols.append(mixed * diff)
+                nulls.append(None)
+                cols.append(nn_i)
+                nulls.append(None)
         else:
             raise NotImplementedError(agg.func)
     return Batch(
@@ -355,6 +376,52 @@ def minmax_contributions(
     return out
 
 
+def basic_state_schema(
+    input_schema: Schema, group_key, agg: AggregateExpr
+) -> Schema:
+    """State schema for one basic (collection) aggregate's multiset.
+    NULL-preserving funcs (array_agg/list_agg) carry a nullable value
+    lane — NULL elements sort first (lanes.py null lane) and render as
+    NULL at the serving edge; string_agg reuses the min/max layout
+    (NULLs dropped)."""
+    if not agg.func.preserves_nulls:
+        return minmax_state_schema(input_schema, group_key, agg)
+    cols = [input_schema[i] for i in group_key]
+    inner = agg.expr.typ(input_schema)
+    cols.append(Column("__v__", inner.ctype, True, inner.scale))
+    return Schema(cols)
+
+
+def basic_contributions(
+    batch: Batch, group_key, agg: AggregateExpr, state_schema: Schema,
+    time=None,
+) -> Batch:
+    """Multiset updates for a basic aggregate: like minmax but NULL
+    elements survive (with the null flag set) for NULL-preserving
+    funcs."""
+    if not agg.func.preserves_nulls:
+        return minmax_contributions(
+            batch, group_key, agg, state_schema, time
+        )
+    cols = [batch.cols[i] for i in group_key]
+    nulls = [batch.nulls[i] for i in group_key]
+    ev = eval_expr(agg.expr, batch, time)
+    vcol = state_schema[len(group_key)]
+    isnull = ev.null_mask()
+    cols.append(
+        jnp.where(isnull, 0, ev.values).astype(vcol.dtype)
+    )
+    nulls.append(isnull)
+    return Batch(
+        cols=tuple(cols),
+        nulls=tuple(nulls),
+        time=batch.time,
+        diff=batch.diff,
+        count=batch.count,
+        schema=state_schema,
+    )
+
+
 def minmax_query(state: Arrangement, probe_lanes, is_max: bool):
     """Current min (or max) value per probe group from the sorted state.
 
@@ -429,13 +496,13 @@ class ReduceOp:
             minmax_state_schema(self.input_schema, self.group_key, a)
             for _, a in self.hier_aggs
         )
-        # Basic multiset parts reuse the min/max multiset layout: a
-        # sorted (key..., value) arrangement with NULL inputs dropped
-        # (string_agg skips NULLs; array_agg follows the reference's
-        # AggregateFunc semantics which also filter nulls,
-        # expr/src/relation/func.rs:1950).
+        # Basic multiset parts: sorted (key..., value) arrangements.
+        # string_agg drops NULL inputs (pg semantics); array_agg and
+        # list_agg keep NULL elements via a nullable value lane
+        # (sql/src/func.rs:3668 wraps values in ArrayCreate before
+        # ArrayConcat for exactly this).
         self.basic_schemas = tuple(
-            minmax_state_schema(self.input_schema, self.group_key, a)
+            basic_state_schema(self.input_schema, self.group_key, a)
             for _, a in self.basic_aggs
         )
         self.out_schema = output_schema(
@@ -505,7 +572,7 @@ class ReduceOp:
             zip(self.basic_aggs, self.basic_schemas), start=base_p
         ):
             b_state = state[p]
-            b_contrib = minmax_contributions(
+            b_contrib = basic_contributions(
                 delta, self.group_key, agg, sch, out_time
             )
             new_b, overflow[p] = insert(

@@ -750,7 +750,11 @@ def _resolve_basic_sites(expr: mir.RelationExpr, ctx) -> list:
     may flow to the output through Project/Map/Filter layers that do
     not COMPUTE on it; anything else would leak digests into real
     operators, so it raises. Returns
-    [(output col, state slot, state part, AggregateExpr, value Column)].
+    [(output col, state slot, state part, AggregateExpr, value Column,
+      key_out_cols)] where key_out_cols maps each group-key column to
+    its position in the OUTPUT schema (None if any key column was
+    projected away — finalization then falls back to digest-only
+    lookup).
     """
     if not ctx.basic_sites:
         return []
@@ -764,6 +768,12 @@ def _resolve_basic_sites(expr: mir.RelationExpr, ctx) -> list:
     if id(node) in sites:
         slot, op = sites.pop(id(node))
         pos: dict = {}
+        # Track the group-key columns through the chain too: when they
+        # all survive to the output, finalization keys its lookup by
+        # group key (digest demoted to a consistency check) — a 64-bit
+        # digest collision between two groups then raises instead of
+        # silently serving one group's result for the other.
+        keypos: dict = {k: k for k in range(op.n_key)}
         for b, (j, agg) in enumerate(op.basic_aggs):
             part = 1 + len(op.hier_aggs) + b
             vcol = agg.expr.typ(op.input_schema)
@@ -791,7 +801,18 @@ def _resolve_basic_sites(expr: mir.RelationExpr, ctx) -> list:
                     for o, srcidx in enumerate(layer.outputs)
                     if srcidx in pos
                 }
-        finalizers = [(o, *v) for o, v in pos.items()]
+                inv = {}
+                for o, srcidx in enumerate(layer.outputs):
+                    for k, p in keypos.items():
+                        if p == srcidx and k not in inv:
+                            inv[k] = o
+                keypos = inv
+        key_out = (
+            tuple(keypos[k] for k in range(op.n_key))
+            if len(keypos) == op.n_key
+            else None
+        )
+        finalizers = [(o, *v, key_out) for o, v in pos.items()]
     if sites:
         raise NotImplementedError(
             "string_agg/array_agg/list_agg must sit at the dataflow "
@@ -802,15 +823,17 @@ def _resolve_basic_sites(expr: mir.RelationExpr, ctx) -> list:
     return finalizers
 
 
-def _finalize_basic_value(agg, vcol, values, mults) -> str:
+def _finalize_basic_value(agg, vcol, values, vnulls, mults, gdict) -> str:
     """Materialize one group's basic-aggregate result from its sorted
-    multiset (host side)."""
+    multiset (host side). ``vnulls`` marks NULL elements (array_agg/
+    list_agg preserve them; rendered as pg's array NULL literal).
+    ``gdict`` is the caller's epoch-coherent dictionary snapshot."""
     from ..expr.relation import AggregateFunc
-    from ..repr.schema import GLOBAL_DICT, ColumnType
+    from ..repr.schema import ColumnType
 
     def render(v) -> str:
         if vcol.ctype is ColumnType.STRING:
-            return GLOBAL_DICT.decode(int(v))
+            return gdict.decode(int(v))
         if vcol.ctype is ColumnType.BOOL:
             return "t" if v else "f"
         if vcol.ctype is ColumnType.DECIMAL and vcol.scale:
@@ -829,8 +852,13 @@ def _finalize_basic_value(agg, vcol, values, mults) -> str:
         return str(int(v))
 
     parts: list = []
-    for v, m in zip(values, mults):
-        parts.extend([render(v)] * int(m))
+    for i, (v, m) in enumerate(zip(values, mults)):
+        s = (
+            "NULL"
+            if vnulls is not None and bool(vnulls[i])
+            else render(v)
+        )
+        parts.extend([s] * int(m))
     if agg.func is AggregateFunc.STRING_AGG:
         sep = agg.params[0] if agg.params else ""
         return sep.join(parts)
@@ -1360,81 +1388,157 @@ class Dataflow(_DataflowBase):
         cols = cols + [
             np.asarray(b.time)[:n], np.asarray(b.diff)[:n]
         ]
-        return [tuple(x.item() for x in row) for row in zip(*cols)]
+        return [
+            tuple(
+                x.item() if isinstance(x, np.generic) else x
+                for x in row
+            )
+            for row in zip(*cols)
+        ]
 
     def finalize_basic_columns(self, cols, nulls) -> list:
         """Edge finalization of basic aggregates (render/reduce.rs:369
         analog): replace each digest value in the host output columns
-        with the dictionary code of the group's materialized result,
-        computed from the maintained (key, value) multiset state. The
-        digest<->group association needs no key matching: equal digests
-        imply equal multisets (splitmix64 sum), which imply equal
-        results."""
+        with the group's materialized result STRING (object-dtype
+        column; decode_result_rows passes pre-decoded columns through —
+        results never round-trip the global dictionary, which peeks
+        under churn would otherwise grow without bound), computed from
+        the maintained (key, value) multiset state.
+
+        When every group-key column survives to the output, the lookup
+        is keyed by group key with the digest as a consistency check
+        (a 64-bit digest collision between groups raises instead of
+        serving the wrong group's result); digest-only lookup is the
+        fallback for outputs that project keys away."""
         if not self._basic_finalizers:
             return list(cols)
-        from ..ops.reduce import _mix64_host
+        from ..ops.reduce import _NULL_DIGEST, _mix64_host
         from ..repr.schema import GLOBAL_DICT
 
+        gdict = GLOBAL_DICT.snapshot()
         cols = list(cols)
-        for (out_col, slot, part, agg, vcol) in self._basic_finalizers:
+        for (
+            out_col, slot, part, agg, vcol, key_out
+        ) in self._basic_finalizers:
             arr = self.states[slot][part]
-            b = arr.batch
-            n = int(b.count)
-            bcols = [np.asarray(c)[:n] for c in b.cols]
-            bnulls = [
-                None if x is None else np.asarray(x)[:n]
-                for x in b.nulls
-            ]
-            diffs = np.asarray(b.diff)[:n]
+            b = self._basic_multiset_host(arr)
+            n = int(b["n"])
+            bcols, bnulls, diffs = b["cols"], b["nulls"], b["diff"]
             keep = diffs != 0
             n_key = len(arr.key)
             vals = bcols[n_key][keep].astype(np.int64)
+            vnl = bnulls[n_key]
+            vnl = vnl[keep] if vnl is not None else None
             mult = diffs[keep]
-            table: dict = {}
+            by_digest: dict = {}
+            by_key: dict = {}
             if len(vals):
+                # Masked key columns, computed ONCE (the per-group loop
+                # below only indexes them — re-masking per group made
+                # finalization O(groups * rows)).
+                kcols = [bcols[ki][keep] for ki in range(n_key)]
+                knulls = [
+                    None if bnulls[ki] is None else bnulls[ki][keep]
+                    for ki in range(n_key)
+                ]
                 # Group boundaries: multiset rows sort by (key, value)
                 # with NULL keys canonicalized first, so groups are
                 # contiguous; compare raw values gated on null flags.
                 change = np.zeros(len(vals), dtype=bool)
                 change[0] = True
-                for ki in range(n_key):
-                    kc = bcols[ki][keep]
-                    nl = bnulls[ki]
+                for kc, nl in zip(kcols, knulls):
                     if nl is None:
                         change[1:] |= kc[1:] != kc[:-1]
                     else:
-                        nl = nl[keep]
                         both = ~nl[1:] & ~nl[:-1]
                         change[1:] |= (nl[1:] != nl[:-1]) | (
                             both & (kc[1:] != kc[:-1])
                         )
                 starts = np.flatnonzero(change)
                 ends = np.append(starts[1:], len(vals))
-                m = _mix64_host(vals).astype(np.uint64) * mult.astype(
-                    np.uint64
-                )
+                m = _mix64_host(vals).astype(np.uint64)
+                if vnl is not None:
+                    m = np.where(
+                        vnl,
+                        np.uint64(np.int64(_NULL_DIGEST)),
+                        m,
+                    )
+                m = m * mult.astype(np.uint64)
                 for s0, e0 in zip(starts, ends):
                     dig = int(
                         m[s0:e0].sum(dtype=np.uint64).astype(np.int64)
                     )
                     res = _finalize_basic_value(
-                        agg, vcol, vals[s0:e0], mult[s0:e0]
+                        agg, vcol, vals[s0:e0],
+                        vnl[s0:e0] if vnl is not None else None,
+                        mult[s0:e0], gdict,
                     )
-                    table[dig] = GLOBAL_DICT.encode(res)
-            col = np.asarray(cols[out_col]).copy()
+                    by_digest[dig] = res
+                    if key_out is not None:
+                        kt = tuple(
+                            None
+                            if knulls[ki] is not None
+                            and bool(knulls[ki][s0])
+                            else kcols[ki][s0].item()
+                            for ki in range(n_key)
+                        )
+                        by_key[kt] = (dig, res)
+            src = np.asarray(cols[out_col])
+            out = np.empty(len(src), dtype=object)
             nl = nulls[out_col] if nulls else None
-            for i in range(len(col)):
+            key_src = (
+                [np.asarray(cols[ko]) for ko in key_out]
+                if key_out is not None
+                else None
+            )
+            for i in range(len(src)):
                 if nl is not None and nl[i]:
+                    out[i] = None
                     continue
-                d = int(col[i])
-                if d not in table:
-                    raise RuntimeError(
-                        "basic-aggregate digest has no multiset group "
-                        "(digest/multiset divergence)"
+                d = int(src[i])
+                if key_out is not None:
+                    kt = tuple(
+                        None
+                        if nulls[ko] is not None and bool(nulls[ko][i])
+                        else key_src[kk][i].item()
+                        for kk, ko in enumerate(key_out)
                     )
-                col[i] = table[d]
-            cols[out_col] = col
+                    hit = by_key.get(kt)
+                    if hit is None:
+                        raise RuntimeError(
+                            "basic-aggregate group has no multiset "
+                            "entry (state divergence)"
+                        )
+                    dig, res = hit
+                    if dig != d:
+                        raise RuntimeError(
+                            "basic-aggregate digest mismatch for group "
+                            f"{kt!r} (digest/multiset divergence)"
+                        )
+                    out[i] = res
+                else:
+                    if d not in by_digest:
+                        raise RuntimeError(
+                            "basic-aggregate digest has no multiset "
+                            "group (digest/multiset divergence)"
+                        )
+                    out[i] = by_digest[d]
+            cols[out_col] = out
         return cols
+
+    def _basic_multiset_host(self, arr) -> dict:
+        """Host view of one basic-aggregate multiset arrangement."""
+        b = arr.batch
+        n = int(b.count)
+        return {
+            "n": n,
+            "cols": [np.asarray(c)[:n] for c in b.cols],
+            "nulls": [
+                None if x is None else np.asarray(x)[:n]
+                for x in b.nulls
+            ],
+            "diff": np.asarray(b.diff)[:n],
+        }
 
     def peek_errors(self) -> list[tuple]:
         """The maintained err collection: [(err_code, count)] with

@@ -135,6 +135,29 @@ class DictExhausted(RuntimeError):
     strings, storage/persist/codec.py)."""
 
 
+class DictSnapshot:
+    """An immutable epoch-coherent read view of the dictionary (see
+    StringDictionary.snapshot). Decodes codes of ITS generation even
+    after a later rebalance has relabeled the live dictionary."""
+
+    __slots__ = ("_codes", "_by_code", "_sorted", "epoch")
+
+    def __init__(self, codes, by_code, sorted_, epoch):
+        self._codes = codes
+        self._by_code = by_code
+        self._sorted = sorted_
+        self.epoch = epoch
+
+    def decode(self, code: int) -> str:
+        return self._by_code[int(code)]
+
+    def decode_many(self, codes) -> list[str]:
+        return [self._by_code[int(c)] for c in np.asarray(codes)]
+
+    def items_sorted(self) -> list[tuple[int, str]]:
+        return [(self._codes[s], s) for s in self._sorted]
+
+
 class StringDictionary:
     """Host-side string dictionary: str <-> ORDER-PRESERVING int64 code.
 
@@ -179,6 +202,27 @@ class StringDictionary:
         # command history, replica dataflows) can remap/rebuild.
         self._listeners: list = []
 
+    def snapshot(self) -> "DictSnapshot":
+        """An epoch-coherent read view. rebalance() REBINDS the internal
+        maps (never mutates them in place), so a snapshot taken before a
+        rebalance keeps decoding pre-rebalance codes correctly while the
+        live dictionary already serves the new labeling — multi-row read
+        operations (env-table builds, result decodes, persist part
+        encodes) capture one snapshot at entry so a concurrent rebalance
+        can never make them mix labelings mid-operation (torn reads were
+        observed as KeyError on decode and garbage env tables)."""
+        with self._lock:
+            return DictSnapshot(
+                self._codes, self._by_code, self._sorted, self.epoch
+            )
+
+    def lock(self):
+        """The dictionary's reentrant lock: held by rebalance() for the
+        whole relabel+listener cycle. Long read-modify cycles that must
+        not interleave with a rebalance (the env-table build, which both
+        reads items and encodes result strings) run under it."""
+        return self._lock
+
     def add_rebalance_listener(self, fn) -> None:
         with self._lock:
             self._listeners.append(fn)
@@ -210,8 +254,12 @@ class StringDictionary:
                 remap[self._codes[s]] = new
                 new_codes[s] = new
                 new_by_code[new] = s
+            # REBIND (never mutate) so pre-rebalance snapshots stay
+            # coherent: their maps keep the old labeling; _sorted is
+            # rebound too because encode() inserts into it in place.
             self._codes = new_codes
             self._by_code = new_by_code
+            self._sorted = list(self._sorted)
             self.version += 1
             self.epoch += 1
             for fn in list(self._listeners):
@@ -402,13 +450,24 @@ def decode_result_rows(schema: Schema, cols, nulls, time, diff) -> list:
     import decimal as _dec
 
     out = []
+    pre_decoded = [
+        getattr(c, "dtype", None) == np.dtype(object) for c in cols
+    ]
+    # One dictionary snapshot for the whole batch: a concurrent
+    # rebalance must not relabel codes mid-decode.
+    gdict = GLOBAL_DICT.snapshot()
     for i in range(len(diff)):
         vals = []
         for j, col in enumerate(schema.columns):
             if nulls[j] is not None and bool(nulls[j][i]):
                 vals.append(None)
+            elif pre_decoded[j]:
+                # Edge-finalized basic-aggregate columns arrive as raw
+                # Python strings (finalize_basic_columns) — they never
+                # enter the dictionary.
+                vals.append(cols[j][i])
             elif col.ctype is ColumnType.STRING:
-                vals.append(GLOBAL_DICT.decode(int(cols[j][i])))
+                vals.append(gdict.decode(int(cols[j][i])))
             elif col.ctype is ColumnType.DECIMAL and col.scale:
                 # scaled int -> exact decimal (the user-facing value;
                 # _encode_internal re-scales on the way back in)

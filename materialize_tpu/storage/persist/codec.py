@@ -87,6 +87,13 @@ def encode_part(
     n = len(diff)
     buffers: list[bytes] = []
     col_meta = []
+    # One dictionary snapshot for the whole part: codes in `cols` were
+    # assigned under the current (or an earlier same-epoch) labeling; a
+    # rebalance concurrent with this encode must not relabel mid-part.
+    # The NULL-placeholder "" is ensured BEFORE the snapshot so the
+    # snapshot always covers it.
+    empty_code = GLOBAL_DICT.encode("")
+    gdict = GLOBAL_DICT.snapshot()
     for i, (c, a) in enumerate(zip(schema.columns, cols)):
         a = np.asarray(a)
         assert len(a) == n, f"column {c.name}: {len(a)} rows != {n}"
@@ -100,9 +107,9 @@ def encode_part(
             # column on decode).
             codes = np.asarray(a, dtype=np.int64).copy()
             if nl is not None:
-                codes[np.asarray(nl, bool)] = GLOBAL_DICT.encode("")
+                codes[np.asarray(nl, bool)] = empty_code
             uniq, inv = np.unique(codes, return_inverse=True)
-            local_strings = [GLOBAL_DICT.decode(u) for u in uniq]
+            local_strings = [gdict.decode(u) for u in uniq]
             a = inv.astype(np.int64)
         buf, enc = _enc_buffer(a)
         buffers.append(buf)

@@ -1,0 +1,77 @@
+"""Round-5 bench design probe: on the real TPU, with a FRESH compile
+cache (simulating the driver machine), measure
+  1. device_put bandwidth through the tunnel (bulk state upload),
+  2. cold-compile time of the index config's step + compact programs
+     at the checked-in final tiers,
+  3. steady-state step execution time.
+Run: MATERIALIZE_TPU_COMPILE_CACHE=/tmp/fresh_cache_$$ python scripts/probe_r5_bench.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+t0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - t0:8.1f}s] {msg}", flush=True)
+
+
+import jax
+import jax.numpy as jnp
+
+log(f"devices: {jax.devices()}")
+
+# 1. device_put bandwidth: 13 cols + time + diff at 2^21 i64 ~ 250MB
+arrs = [np.arange(1 << 21, dtype=np.int64) + i for i in range(15)]
+t = time.perf_counter()
+devs = [jax.device_put(a) for a in arrs]
+jax.block_until_ready(devs)
+dt = time.perf_counter() - t
+mb = sum(a.nbytes for a in arrs) / 1e6
+log(f"device_put {mb:.0f}MB in {dt:.2f}s -> {mb/dt:.0f} MB/s")
+del devs, arrs
+
+# 2. cold compile of index config step program at final tiers
+import bench
+
+with open(bench.TIERS_PATH) as f:
+    tiers = json.load(f)
+
+log("building config_index (generates sf=0.25 snapshot host-side)...")
+t = time.perf_counter()
+df, hydrate, churn = bench.CONFIGS["index"]()
+log(f"config_index() built in {time.perf_counter() - t:.1f}s "
+    f"({len(hydrate)} hydrate batches)")
+t = time.perf_counter()
+bench.apply_tiers(df, tiers["index"])
+log(f"apply_tiers in {time.perf_counter() - t:.1f}s")
+
+# one churn step (no hydration -- compile shapes don't depend on content)
+inp, n = churn(0, 1000)
+t = time.perf_counter()
+deltas = df.run_steps([inp], defer_check=True)
+jax.block_until_ready(jax.tree_util.tree_leaves(deltas))
+log(f"first step (COLD compile + exec) in {time.perf_counter() - t:.1f}s")
+
+t = time.perf_counter()
+cfl = df._dispatch_compact()
+jax.block_until_ready(cfl)
+log(f"first compact (COLD compile + exec) in {time.perf_counter() - t:.1f}s")
+
+# steady-state steps
+span = []
+for i in range(1, 25):
+    ip, _ = churn(i, 1000 + i)
+    span.append(ip)
+t = time.perf_counter()
+d = df.run_steps(span, defer_check=True)
+jax.block_until_ready(jax.tree_util.tree_leaves(d[-1]))
+dt = time.perf_counter() - t
+log(f"24 steps in {dt:.2f}s ({dt/24*1000:.2f} ms/step)")
+log("done")

@@ -1,0 +1,47 @@
+"""Is there a server-side compile cache? Compile the index step program
+at a NEVER-seen tier (out base 2^21 + tail 2^15+4096 variant) and time.
+If ~26s like the cached-tier probe, cold compiles are cheap and r04's
+timeout came from elsewhere; if >>100s, compiles must be pre-warmed."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+t0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - t0:8.1f}s] {msg}", flush=True)
+
+
+import jax
+import bench
+
+with open(bench.TIERS_PATH) as f:
+    tiers = json.load(f)["index"]
+
+# Perturb: tail tier one rung up -> a program shape no process has built.
+tiers = json.loads(json.dumps(tiers))
+for entry in tiers["grow"]:
+    if entry[0] == ["out", "tail"]:
+        entry[1] = 65536
+
+log("building config_index...")
+df, hydrate, churn = bench.CONFIGS["index"]()
+t = time.perf_counter()
+bench.apply_tiers(df, tiers)
+log(f"apply_tiers in {time.perf_counter() - t:.1f}s")
+
+inp, n = churn(0, 1000)
+t = time.perf_counter()
+deltas = df.run_steps([inp], defer_check=True)
+jax.block_until_ready(jax.tree_util.tree_leaves(deltas))
+log(f"first step (NEVER-SEEN shape compile + exec) in "
+    f"{time.perf_counter() - t:.1f}s")
+t = time.perf_counter()
+cfl = df._dispatch_compact()
+jax.block_until_ready(cfl)
+log(f"first compact (NEVER-SEEN shape) in {time.perf_counter() - t:.1f}s")
+log("done")

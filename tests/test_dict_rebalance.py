@@ -246,3 +246,43 @@ class TestStringsSltAfterLargeDict:
             run_slt_file(path, c)
         finally:
             c.shutdown()
+
+
+class TestSnapshotCoherence:
+    """A rebalance concurrent with an in-flight multi-row read must not
+    tear the labeling mid-operation (round-4 advisor finding): readers
+    capture an epoch-coherent DictSnapshot at entry; rebalance REBINDS
+    the internal maps, so the snapshot keeps decoding pre-rebalance
+    codes while the live dictionary serves the new labeling."""
+
+    def test_snapshot_survives_rebalance(self):
+        code_a = GLOBAL_DICT.encode("snapcoh-a")
+        snap = GLOBAL_DICT.snapshot()
+        assert snap.decode(code_a) == "snapcoh-a"
+        remap = GLOBAL_DICT.rebalance()
+        new_a = remap[code_a]
+        # Live dict: only the new labeling.
+        assert GLOBAL_DICT.decode(new_a) == "snapcoh-a"
+        # Old snapshot: still decodes the OLD code (a step that read
+        # device arrays holding old codes finishes coherently).
+        assert snap.decode(code_a) == "snapcoh-a"
+        assert snap.epoch == GLOBAL_DICT.epoch - 1
+
+    def test_same_epoch_inserts_visible_to_snapshot(self):
+        snap = GLOBAL_DICT.snapshot()
+        c = GLOBAL_DICT.encode("snapcoh-late-insert")
+        # Same generation: the snapshot shares the live maps.
+        assert snap.decode(c) == "snapcoh-late-insert"
+        items = dict((s, k) for k, s in snap.items_sorted())
+        assert items["snapcoh-late-insert"] == c
+
+    def test_post_rebalance_inserts_invisible_to_snapshot(self):
+        snap = GLOBAL_DICT.snapshot()
+        GLOBAL_DICT.rebalance()
+        c = GLOBAL_DICT.encode("snapcoh-after-rebalance")
+        with pytest.raises(KeyError):
+            snap.decode(c)
+        # items_sorted on the old snapshot stays self-consistent (no
+        # KeyError from post-rebalance insertions into _sorted).
+        for k, s in snap.items_sorted():
+            assert snap.decode(k) == s
