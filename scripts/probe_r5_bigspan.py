@@ -1,0 +1,35 @@
+"""Does a single 419-dispatch async span stall the TPU tunnel?
+Reproduces bench.measure's exact hydration call (no chunking)."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+t0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - t0:8.1f}s] {msg}", flush=True)
+
+
+import jax
+import bench
+
+with open(bench.TIERS_PATH) as f:
+    tiers = json.load(f)["index"]
+
+log("building config_index...")
+df, hydrate, churn = bench.CONFIGS["index"]()
+t = time.perf_counter()
+bench.apply_tiers(df, tiers)
+log(f"apply_tiers in {time.perf_counter() - t:.1f}s")
+
+t = time.perf_counter()
+df.run_steps(hydrate, defer_check=True)
+log(f"run_steps({len(hydrate)}) dispatched in {time.perf_counter() - t:.1f}s")
+t = time.perf_counter()
+bench._block(df.output.base.diff)
+log(f"block in {time.perf_counter() - t:.1f}s")
+log("done")

@@ -1,0 +1,132 @@
+"""Bisect compact_spine's 10.8s: time merge_sorted and
+consolidate_sorted separately at 2^21, then chained primitive loops
+(10x dependent) to get per-op costs without RTT noise."""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import materialize_tpu  # noqa: F401
+from materialize_tpu.arrangement.spine import Arrangement, Spine
+from materialize_tpu.ops.consolidate import consolidate_sorted
+from materialize_tpu.ops.merge import merge_sorted
+from materialize_tpu.ops.sort import compact, segment_ids, segment_starts
+from materialize_tpu.repr.batch import Batch
+from materialize_tpu.storage.generator.tpch import LINEITEM_SCHEMA
+
+np.asarray(jnp.zeros((1,)) + 1)
+
+
+def timed(f, *args, reps=3):
+    r = f(*args)
+    jax.block_until_ready(jax.tree_util.tree_leaves(r))
+    ts = []
+    for _ in range(reps):
+        t = time.perf_counter()
+        r = f(*args)
+        jax.block_until_ready(jax.tree_util.tree_leaves(r))
+        ts.append(time.perf_counter() - t)
+    return min(ts)
+
+
+@jax.jit
+def noop(x):
+    return x + 1
+
+
+base = timed(noop, jnp.zeros((8,)))
+print(f"RTT baseline: {base*1000:.1f}ms", flush=True)
+
+
+def rpt(name, dt):
+    print(f"{name:40s}: {max(dt-base,0)*1000:9.2f}ms", flush=True)
+
+
+N = 1 << 21
+key = tuple(range(LINEITEM_SCHEMA.arity))
+big = Batch.empty(LINEITEM_SCHEMA, N)
+tail = Batch.empty(LINEITEM_SCHEMA, 32768)
+barr = Arrangement(big, key)
+tarr = Arrangement(tail, key)
+
+
+@jax.jit
+def just_merge(b, t):
+    ba, ta = Arrangement(b, key), Arrangement(t, key)
+    m, _ = merge_sorted(b, ba.sort_lanes(), t, ta.sort_lanes(), N)
+    return m
+
+
+@jax.jit
+def just_consolidate(b):
+    arr = Arrangement(b, key)
+    return consolidate_sorted(b, arr.sort_lanes())
+
+
+@jax.jit
+def just_segstarts(b):
+    arr = Arrangement(b, key)
+    lanes = arr.sort_lanes()
+    return segment_starts(lanes, b.count, N)
+
+
+@jax.jit
+def just_compact(b):
+    return compact(b, b.diff != 0)
+
+
+rpt("merge_sorted 2M+32k -> 2M", timed(just_merge, big, tail))
+rpt("consolidate_sorted 2M", timed(just_consolidate, big))
+rpt("segment_starts 2M (16 lanes)", timed(just_segstarts, big))
+rpt("compact 2M (33 scatters)", timed(just_compact, big))
+
+# chained primitive loops at 2M
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.integers(0, 1 << 40, N).astype(np.int64))
+p = jnp.asarray(rng.permutation(N).astype(np.int32))
+
+
+@jax.jit
+def chain_scatter_set(x, p):
+    for i in range(10):
+        x = jnp.zeros_like(x).at[p].set(x + i)
+    return x
+
+
+@jax.jit
+def chain_scatter_add(x, p):
+    acc = jnp.zeros_like(x)
+    for i in range(10):
+        acc = acc.at[p].add(x + i)
+    return acc
+
+
+@jax.jit
+def chain_gather(x, p):
+    for i in range(10):
+        x = x[p] + 1
+    return x
+
+
+@jax.jit
+def chain_cumsum(x):
+    for i in range(10):
+        x = jnp.cumsum(x) % 1000003
+    return x
+
+
+@jax.jit
+def chain_sort(x):
+    for i in range(3):
+        x = jnp.sort(x ^ 12345)
+    return x
+
+
+rpt("10x chained scatter-set 2M", timed(chain_scatter_set, x, p))
+rpt("10x chained scatter-add 2M", timed(chain_scatter_add, x, p))
+rpt("10x chained gather 2M", timed(chain_gather, x, p))
+rpt("10x chained cumsum 2M", timed(chain_cumsum, x))
+rpt("3x chained sort 2M", timed(chain_sort, x))

@@ -1,0 +1,65 @@
+"""Does block_until_ready on the axon tunnel actually wait for
+execution? Dispatch a chain of big matmuls (known, measurable device
+cost), compare block_until_ready wall time vs np.asarray wall time."""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+t0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - t0:8.1f}s] {msg}", flush=True)
+
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import materialize_tpu  # noqa: F401  (x64 + cache config)
+
+N = 4096
+ITERS = 200  # 200 chained 4096^2 bf16 matmuls ~ 2.7e13 FLOP ~ 0.1-0.3s on v5e
+
+
+@jax.jit
+def chain(x):
+    def body(i, a):
+        return a @ a * jnp.bfloat16(1e-3) + jnp.bfloat16(1.0)
+
+    return jax.lax.fori_loop(0, ITERS, body, x)
+
+
+x = jnp.asarray(np.random.rand(N, N), dtype=jnp.bfloat16)
+# warm compile
+y = chain(x)
+t = time.perf_counter()
+jax.block_until_ready(y)
+log(f"block after compile+first run: {time.perf_counter() - t:.3f}s")
+t = time.perf_counter()
+_ = np.asarray(y[0, :1])  # readback switches mode
+log(f"first tiny readback: {time.perf_counter() - t:.3f}s")
+
+# Now: dispatch again (sync mode?) and compare block vs asarray
+t = time.perf_counter()
+y2 = chain(y)
+log(f"dispatch #2: {time.perf_counter() - t:.3f}s")
+t = time.perf_counter()
+jax.block_until_ready(y2)
+log(f"block #2: {time.perf_counter() - t:.3f}s")
+t = time.perf_counter()
+_ = np.asarray(y2[0, :1])
+log(f"tiny readback #2: {time.perf_counter() - t:.3f}s")
+
+# 10 chained dispatches, then block, then pull
+t = time.perf_counter()
+z = y2
+for _ in range(10):
+    z = chain(z)
+log(f"10 dispatches: {time.perf_counter() - t:.3f}s")
+t = time.perf_counter()
+jax.block_until_ready(z)
+log(f"block after 10: {time.perf_counter() - t:.3f}s")
+t = time.perf_counter()
+_ = np.asarray(z[0, :1])
+log(f"tiny readback after 10: {time.perf_counter() - t:.3f}s")

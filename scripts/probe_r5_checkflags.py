@@ -1,0 +1,38 @@
+"""How does check_flags() cost scale with the number of deferred steps?
+Uses the index config at checked-in tiers; hydrates N steps deferred,
+then times check_flags."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+t0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - t0:8.1f}s] {msg}", flush=True)
+
+
+import jax
+import bench
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+
+with open(bench.TIERS_PATH) as f:
+    tiers = json.load(f)["index"]
+
+df, hydrate, churn = bench.CONFIGS["index"]()
+bench.apply_tiers(df, tiers)
+log(f"built+tiers; running {N} deferred steps")
+t = time.perf_counter()
+df.run_steps(hydrate[:N], defer_check=True)
+jax.block_until_ready(jax.tree_util.tree_leaves(df.output.base.diff))
+log(f"{N} steps dispatched+blocked in {time.perf_counter() - t:.2f}s")
+t = time.perf_counter()
+ovf = df.check_flags()
+log(f"check_flags in {time.perf_counter() - t:.2f}s (ovf={ovf})")
+t = time.perf_counter()
+ovf = df.check_flags()
+log(f"second check_flags in {time.perf_counter() - t:.3f}s")

@@ -1,0 +1,41 @@
+"""Instrument the measure path's hydration to find where r04's 900s
+went: build, apply_tiers, then hydrate in chunks of 25 steps with
+per-chunk wall times."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+t0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - t0:8.1f}s] {msg}", flush=True)
+
+
+import jax
+import bench
+
+with open(bench.TIERS_PATH) as f:
+    tiers = json.load(f)["index"]
+
+log("building config_index...")
+df, hydrate, churn = bench.CONFIGS["index"]()
+log(f"built ({len(hydrate)} hydrate batches)")
+t = time.perf_counter()
+bench.apply_tiers(df, tiers)
+log(f"apply_tiers in {time.perf_counter() - t:.1f}s")
+
+CH = 25
+for i in range(0, len(hydrate), CH):
+    t = time.perf_counter()
+    deltas = df.run_steps(hydrate[i : i + CH], defer_check=True)
+    jax.block_until_ready(jax.tree_util.tree_leaves(deltas[-1]))
+    dt = time.perf_counter() - t
+    log(f"hydrate[{i}:{i+CH}] in {dt:.2f}s ({dt/CH*1000:.1f} ms/step)")
+    if time.perf_counter() - t0 > 600:
+        log("bailing at 600s")
+        break
+log("done")

@@ -1,0 +1,97 @@
+"""Honest timing of the REAL composed kernels at bench shapes:
+arrange, insert_tail, compact_spine, consolidate, sort_perm,
+lex_searchsorted with the lineitem schema's 16 lanes."""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import materialize_tpu  # noqa: F401
+from materialize_tpu.arrangement.spine import (
+    Arrangement,
+    Spine,
+    arrange,
+    compact_spine,
+    insert_tail,
+)
+from materialize_tpu.ops.consolidate import consolidate
+from materialize_tpu.ops.sort import sort_perm, apply_perm
+from materialize_tpu.ops.lanes import key_lanes
+from materialize_tpu.ops.search import lex_searchsorted
+from materialize_tpu.repr.batch import Batch
+from materialize_tpu.storage.generator.tpch import (
+    LINEITEM_SCHEMA,
+    TpchGenerator,
+)
+
+np.asarray(jnp.zeros((1,)) + 1)  # honest mode
+
+
+def timed(f, *args, reps=3):
+    r = f(*args)
+    jax.block_until_ready(jax.tree_util.tree_leaves(r))
+    ts = []
+    for _ in range(reps):
+        t = time.perf_counter()
+        r = f(*args)
+        jax.block_until_ready(jax.tree_util.tree_leaves(r))
+        ts.append(time.perf_counter() - t)
+    return min(ts)
+
+
+@jax.jit
+def noop(x):
+    return x + 1
+
+
+base = timed(noop, jnp.zeros((8,)))
+print(f"RTT baseline: {base*1000:.1f}ms", flush=True)
+
+gen = TpchGenerator(sf=0.25, seed=42)
+b4k = gen.churn_lineitem_batch(448, tick=0, time=1, capacity=4096)
+key = tuple(range(LINEITEM_SCHEMA.arity))
+
+lanes = key_lanes(b4k, range(LINEITEM_SCHEMA.arity))
+print(f"lineitem lane count: {len(lanes)}", flush=True)
+
+
+def rpt(name, dt):
+    print(f"{name:36s}: {max(dt-base,0)*1000:9.2f}ms", flush=True)
+
+
+rpt("consolidate 4096", timed(
+    jax.jit(lambda b: consolidate(b, include_time=False)), b4k))
+rpt("arrange 4096 (sort17+cons)", timed(
+    jax.jit(lambda b: arrange(b, key).batch), b4k))
+rpt("sort_perm 16 lanes 4096", timed(
+    jax.jit(lambda b: sort_perm(
+        key_lanes(b, range(13)), b.count, 4096)), b4k))
+rpt("apply_perm 4096", timed(
+    jax.jit(lambda b: apply_perm(b, jnp.arange(4096))), b4k))
+
+# spine at bench tiers: base 2^21, tail 32768
+base_rows = 1 << 21
+tail_cap = 32768
+big = Batch.empty(LINEITEM_SCHEMA, base_rows)
+tail = Batch.empty(LINEITEM_SCHEMA, tail_cap)
+sp = Spine(big, tail, key)
+
+rpt("insert_tail (4096 -> 32768)", timed(
+    jax.jit(lambda s, d: insert_tail(s, d)[0].tail), sp, b4k))
+rpt("compact_spine (2^21 + 32k)", timed(
+    jax.jit(lambda s: compact_spine(s)[0].base), sp))
+
+arr4k = arrange(b4k, key)
+probe = key_lanes(b4k, range(13))
+arr_lanes = arr4k.key_only_lanes()
+rpt("lex_searchsorted 16L 4k/4k", timed(
+    jax.jit(lambda al, c, pl: lex_searchsorted(al, c, pl)),
+    arr_lanes, b4k.count, probe))
+
+big_lanes = key_lanes(big, range(13))
+rpt("lex_searchsorted 16L 2M/4k", timed(
+    jax.jit(lambda al, c, pl: lex_searchsorted(al, c, pl)),
+    big_lanes, jnp.asarray(base_rows, jnp.int32), probe))

@@ -1,0 +1,68 @@
+"""Does the tunnel re-ship operands per dispatch, or are buffers
+server-resident? f_light does trivial work on a 128MB operand;
+f_heavy does ~100 passes. If times are similar -> transfer-bound."""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+t0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - t0:8.1f}s] {msg}", flush=True)
+
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import materialize_tpu  # noqa: F401
+
+N = 16 * 1024 * 1024  # 128MB f64 (x64 on)
+
+
+@jax.jit
+def f_light(x):
+    return x[:8] + 1.0
+
+
+@jax.jit
+def f_heavy(x):
+    def body(i, a):
+        return a * 1.0000001 + 1e-9
+
+    return jax.lax.fori_loop(0, 100, body, x)[:8]
+
+
+x = jax.device_put(np.random.rand(N))
+jax.block_until_ready(x)
+np.asarray(jnp.zeros((1,)) + 1)  # mode switch
+# warm both compiles
+jax.block_until_ready(f_light(x))
+jax.block_until_ready(f_heavy(x))
+log("warm")
+
+for name, f in [("light", f_light), ("heavy", f_heavy)]:
+    ts = []
+    for _ in range(5):
+        t = time.perf_counter()
+        jax.block_until_ready(f(x))
+        ts.append(time.perf_counter() - t)
+    log(f"f_{name}: min {min(ts)*1000:.1f}ms  med {sorted(ts)[2]*1000:.1f}ms")
+
+# and a no-big-operand baseline
+y = jax.device_put(np.random.rand(8))
+
+
+@jax.jit
+def f_tiny(y):
+    return y + 1.0
+
+
+jax.block_until_ready(f_tiny(y))
+ts = []
+for _ in range(5):
+    t = time.perf_counter()
+    jax.block_until_ready(f_tiny(y))
+    ts.append(time.perf_counter() - t)
+log(f"f_tiny: min {min(ts)*1000:.1f}ms  med {sorted(ts)[2]*1000:.1f}ms")

@@ -1,0 +1,78 @@
+"""Honest-mode (post-first-readback) timing of the index config:
+switch modes FIRST with a tiny readback, then hydrate + measure with
+truthful blocking. Reports REAL steps/s and per-step latency."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+t0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - t0:8.1f}s] {msg}", flush=True)
+
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import bench
+
+with open(bench.TIERS_PATH) as f:
+    tiers = json.load(f)["index"]
+
+df, hydrate, churn = bench.CONFIGS["index"]()
+bench.apply_tiers(df, tiers)
+log("built + tiers applied")
+
+# Enter the honest regime: one tiny readback up front.
+t = time.perf_counter()
+np.asarray(jnp.zeros((1,)) + 1)
+log(f"mode-switch readback: {time.perf_counter() - t:.2f}s")
+
+t = time.perf_counter()
+df.run_steps(hydrate, defer_check=True)
+jax.block_until_ready(df.output.base.diff)
+log(f"hydrate {len(hydrate)} steps (honest block): "
+    f"{time.perf_counter() - t:.2f}s")
+t = time.perf_counter()
+ovf = df.check_flags()
+log(f"check_flags: {time.perf_counter() - t:.2f}s (ovf={ovf})")
+
+# churn spans, honest
+span = []
+counts = []
+t = time.perf_counter()
+for i in range(48):
+    inp, n = churn(i, df.time + i)
+    span.append(inp)
+    counts.append(n)
+log(f"generate 48 churn ticks: {time.perf_counter() - t:.2f}s")
+
+# warmup
+d = df.run_steps(span[:4], defer_check=True)
+jax.block_until_ready(jax.tree_util.tree_leaves(d[-1]))
+
+t = time.perf_counter()
+d = df.run_steps(span[4:28], defer_check=True)
+jax.block_until_ready(jax.tree_util.tree_leaves(d[-1]))
+dt = time.perf_counter() - t
+n_upd = sum(counts[4:28])
+log(f"24-step span: {dt:.3f}s -> {dt/24*1000:.2f} ms/step, "
+    f"{n_upd/dt/1e6:.2f}M updates/s")
+
+lat = []
+for inp in span[28:48]:
+    t = time.perf_counter()
+    d = df.run_steps([inp], defer_check=True)
+    jax.block_until_ready(jax.tree_util.tree_leaves(d[-1]))
+    lat.append(time.perf_counter() - t)
+log(f"per-step latency: p50={1000*np.percentile(lat,50):.2f}ms "
+    f"p99={1000*np.percentile(lat,99):.2f}ms")
+t = time.perf_counter()
+ovf = df.check_flags()
+log(f"final check_flags: {time.perf_counter() - t:.2f}s (ovf={ovf})")
+state_rows = int(np.asarray(df.output.base.count).sum())
+log(f"state_rows={state_rows}")

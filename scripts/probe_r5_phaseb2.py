@@ -1,0 +1,50 @@
+"""Phase-B (honest mode) per-step cost anatomy for the index config:
+time dispatch and block separately for individual steps."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+t0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - t0:8.1f}s] {msg}", flush=True)
+
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import bench
+
+with open(bench.TIERS_PATH) as f:
+    tiers = json.load(f)["index"]
+
+df, hydrate, churn = bench.CONFIGS["index"]()
+bench.apply_tiers(df, tiers)
+np.asarray(jnp.zeros((1,)) + 1)  # mode switch
+log("built + switched")
+
+for i in range(8):
+    t = time.perf_counter()
+    d = df.run_steps([hydrate[i]], defer_check=True)
+    td = time.perf_counter() - t
+    t = time.perf_counter()
+    jax.block_until_ready(jax.tree_util.tree_leaves(d[-1]))
+    tb = time.perf_counter() - t
+    log(f"step {i}: dispatch {td*1000:.1f}ms block {tb*1000:.1f}ms")
+
+# 16 steps dispatched together, one block
+t = time.perf_counter()
+d = df.run_steps(hydrate[8:24], defer_check=True)
+td = time.perf_counter() - t
+t = time.perf_counter()
+jax.block_until_ready(jax.tree_util.tree_leaves(d[-1]))
+tb = time.perf_counter() - t
+log(f"16-step batch: dispatch {td:.2f}s block {tb:.2f}s "
+    f"-> {(td+tb)/16*1000:.1f} ms/step")
+t = time.perf_counter()
+ovf = df.check_flags()
+log(f"check_flags: {time.perf_counter() - t:.2f}s (ovf={ovf})")

@@ -1,0 +1,84 @@
+"""Isolate the d2h readback cost components on the axon tunnel.
+Modes (argv[1]):
+  one      — 1 step; read its flags array only.
+  last     — 24 steps; read ONLY the last step's flags (no OR chain).
+  orchain  — 24 steps with OR accumulation; read the OR.
+  bigread  — 1 step; read the 2^21-row output.base.diff (d2h bandwidth).
+  scalar   — 1 step; read output.base.count scalar.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+t0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - t0:8.1f}s] {msg}", flush=True)
+
+
+import numpy as np
+import jax
+import bench
+
+mode = sys.argv[1]
+
+with open(bench.TIERS_PATH) as f:
+    tiers = json.load(f)["index"]
+
+df, hydrate, churn = bench.CONFIGS["index"]()
+bench.apply_tiers(df, tiers)
+
+n = 1 if mode in ("one", "bigread", "scalar") else 24
+# dispatch steps manually so we control the flags handling
+packed = [df._pack_inputs(i) for i in hydrate[:n]]
+df._first_time = int(df.time)
+df._ctx.first_time = df._first_time
+fls = []
+if df._time_dev is None:
+    import jax.numpy as jnp
+
+    df._time_dev = jnp.asarray(df.time, dtype=jnp.uint64)
+acc = None
+for p in packed:
+    out, new_states, new_output, new_err, new_t, fl = df._step_jit(
+        tuple(df.states), df.output, df.err_output, p, df._time_dev
+    )
+    df.states = list(new_states)
+    df.output = new_output
+    df.err_output = new_err
+    df._time_dev = new_t
+    fls.append(fl)
+    if mode == "orchain":
+        import jax.numpy as jnp
+
+        acc = fl if acc is None else jnp.logical_or(acc, fl)
+
+t = time.perf_counter()
+jax.block_until_ready(df.output.base.diff)
+log(f"block on base.diff after {n} steps: {time.perf_counter() - t:.2f}s")
+
+if mode in ("one", "last"):
+    target = fls[-1]
+elif mode == "orchain":
+    target = acc
+elif mode == "bigread":
+    target = df.output.base.diff
+else:
+    target = df.output.base.count
+
+t = time.perf_counter()
+jax.block_until_ready(target)
+log(f"block on target: {time.perf_counter() - t:.2f}s")
+t = time.perf_counter()
+h = np.asarray(target)
+dt = time.perf_counter() - t
+log(f"np.asarray(target) [{mode}]: {dt:.2f}s "
+    f"({getattr(h, 'nbytes', 0)} bytes)")
+# second readback of something small: post-switch cost
+t = time.perf_counter()
+np.asarray(fls[-1])
+log(f"second small readback: {time.perf_counter() - t:.3f}s")

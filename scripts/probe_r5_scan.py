@@ -1,0 +1,116 @@
+"""Prototype: scan-based span program for the index config.
+One dispatch for K steps: carry=(states, output, err, time, flags),
+xs=stacked input batches. Measures REAL per-step exec by comparing
+span sizes (overhead cancels)."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+t0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - t0:8.1f}s] {msg}", flush=True)
+
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import bench
+
+with open(bench.TIERS_PATH) as f:
+    tiers = json.load(f)["index"]
+
+df, hydrate, churn = bench.CONFIGS["index"]()
+bench.apply_tiers(df, tiers)
+np.asarray(jnp.zeros((1,)) + 1)  # honest mode
+log("built + switched")
+
+df._first_time = int(df.time)
+df._ctx.first_time = df._first_time
+
+
+def stack_inputs(inputs_list):
+    """List of {name: Batch} -> {name: Batch with [K, ...] leaves}."""
+    out = {}
+    for name in inputs_list[0]:
+        bs = [d[name] for d in inputs_list]
+        leaves = [jax.tree_util.tree_flatten(b)[0] for b in bs]
+        treedef = jax.tree_util.tree_flatten(bs[0])[1]
+        stacked = [
+            jnp.stack([l[i] for l in leaves])
+            for i in range(len(leaves[0]))
+        ]
+        out[name] = jax.tree_util.tree_unflatten(treedef, stacked)
+    return out
+
+
+COMPACT_EVERY = 8
+
+
+def make_span_jit(k_chunks):
+    """k_chunks chunks of COMPACT_EVERY steps, one compact per chunk."""
+
+    def span(states, output, err, time_dev, stacked):
+        def body(carry, xs):
+            st, out_sp, e, t = carry
+            out, ns, no, ne, nt, fl = df._step_core(st, out_sp, e, xs, t)
+            return (ns, no, ne, nt), fl
+
+        carry = (tuple(states), output, err, time_dev)
+        all_fl = []
+        for _ in range(k_chunks):
+            chunk = jax.tree_util.tree_map(
+                lambda a: a[:COMPACT_EVERY], stacked
+            )
+            stacked = jax.tree_util.tree_map(
+                lambda a: a[COMPACT_EVERY:], stacked
+            )
+            carry, fls = jax.lax.scan(body, carry, chunk)
+            all_fl.append(fls.any(axis=0))
+            st, out_sp, e, t = carry
+            nst, nout, cfl = df._compact_core_single(st, out_sp)
+            carry = (nst, nout, e, t)
+            all_fl.append(cfl)
+        st, out_sp, e, t = carry
+        flags = jnp.concatenate([f.reshape(-1) for f in all_fl])
+        return st, out_sp, e, t, flags
+
+    return jax.jit(span)
+
+
+if df._time_dev is None:
+    df._time_dev = jnp.asarray(df.time, dtype=jnp.uint64)
+
+for K in (8, 32, 64):
+    span_jit = make_span_jit(K // COMPACT_EVERY)
+    stacked = stack_inputs(hydrate[:K])
+    t = time.perf_counter()
+    st, out_sp, e, tm, flags = span_jit(
+        tuple(df.states), df.output, df.err_output, df._time_dev, stacked
+    )
+    jax.block_until_ready(flags)
+    log(f"K={K}: compile+run {time.perf_counter() - t:.1f}s")
+    # apply, then run again warm
+    df.states = list(st)
+    df.output = out_sp
+    df.err_output = e
+    df._time_dev = tm
+    df._time += K
+    stacked = stack_inputs(hydrate[K : 2 * K])
+    t = time.perf_counter()
+    st, out_sp, e, tm, flags = span_jit(
+        tuple(df.states), df.output, df.err_output, df._time_dev, stacked
+    )
+    jax.block_until_ready(flags)
+    dt = time.perf_counter() - t
+    log(f"K={K}: warm span {dt*1000:.1f}ms -> {dt/K*1000:.2f} ms/step "
+        f"(flags any={bool(np.asarray(flags).any())})")
+    df.states = list(st)
+    df.output = out_sp
+    df.err_output = e
+    df._time_dev = tm
+    df._time += K

@@ -1,0 +1,100 @@
+"""(1) lax.sort compile+exec grid over (operands, size) — is compile
+really superlinear (old fact 4) now that we measure honestly?
+(2) monotone vs random scatter/gather at 2M (merge produces monotone
+indices).  Each cell in a fresh compile (unique shapes)."""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import materialize_tpu  # noqa: F401
+
+np.asarray(jnp.zeros((1,)) + 1)
+
+rng = np.random.default_rng(0)
+
+
+def timed_warm(f, *args, reps=3):
+    t = time.perf_counter()
+    r = f(*args)
+    jax.block_until_ready(jax.tree_util.tree_leaves(r))
+    compile_s = time.perf_counter() - t
+    ts = []
+    for _ in range(reps):
+        t = time.perf_counter()
+        r = f(*args)
+        jax.block_until_ready(jax.tree_util.tree_leaves(r))
+        ts.append(time.perf_counter() - t)
+    return compile_s, min(ts)
+
+
+for n in (32768, 262144, 2097152):
+    for k in (2, 4, 8, 16):
+        ops = tuple(
+            jnp.asarray(rng.integers(0, 1 << 62, n).astype(np.uint64))
+            for _ in range(k)
+        )
+
+        def f(*xs, k=k):
+            return jax.lax.sort(xs, num_keys=k - 1, is_stable=True)
+
+        c, e = timed_warm(jax.jit(f), *ops)
+        print(
+            f"sort n={n:>8} ops={k:>2}: compile {c:7.1f}s exec "
+            f"{e*1000:8.1f}ms",
+            flush=True,
+        )
+
+N = 1 << 21
+x = jnp.asarray(rng.integers(0, 1 << 40, N).astype(np.int64))
+prand = jnp.asarray(rng.permutation(N).astype(np.int32))
+# monotone with random gaps, covering ~half the range
+mono = jnp.asarray(
+    np.sort(rng.choice(2 * N, N, replace=False)).astype(np.int32)
+)
+
+
+@jax.jit
+def chain_scatter_mono(x, p):
+    out = jnp.zeros(2 * N, dtype=x.dtype)
+    for i in range(4):
+        out = out.at[p + i].set(x)
+    return out
+
+
+@jax.jit
+def chain_gather_mono(x, p):
+    big = jnp.concatenate([x, x])
+    acc = x
+    for i in range(4):
+        acc = acc + big[p]
+    return acc
+
+
+@jax.jit
+def chain_scatter_rand(x, p):
+    out = jnp.zeros(N, dtype=x.dtype)
+    for i in range(4):
+        out = out.at[p].set(x + i)
+    return out
+
+
+@jax.jit
+def chain_gather_rand(x, p):
+    for i in range(4):
+        x = x[p] + 1
+    return x
+
+
+for name, f, p in (
+    ("scatter mono", chain_scatter_mono, mono),
+    ("scatter rand", chain_scatter_rand, prand),
+    ("gather mono", chain_gather_mono, mono),
+    ("gather rand", chain_gather_rand, prand),
+):
+    c, e = timed_warm(f, x, p)
+    print(f"{name} x4 @2M: exec {e*1000:8.1f}ms ({e/4*1000:.1f}ms/op)",
+          flush=True)
