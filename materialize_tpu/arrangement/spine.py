@@ -19,6 +19,16 @@ TraceManager, compute/src/arrangement/manager.rs:33). Two forms:
   (multiset sum): lookups probe both runs; a row may appear in both
   with cancelling diffs, which downstream consolidation resolves.
 
+Order modes (round-5 redesign, PERF_NOTES.md): an arrangement is
+sorted either in ``exact`` SQL-lane order (key columns then remaining
+columns — required where readers exploit VALUE order inside a key
+range: min/max, TopK) or in ``hash`` order (a 2-lane hash pair of the
+key then of the full row). Hash order cuts sort operands and search
+lanes from one-per-column to two, which is what lets sorts compile and
+merges execute at state scale; EQUALITY remains exact everywhere
+(consolidation compares full lanes on adjacent rows; a hash collision
+can only make two different rows adjacent, never merge them).
+
 Historical multiversion reads are deferred — with barrier-synchronous
 micro-batch steps every reader sees the state exactly at the step
 frontier, which matches the reference's behavior when logical compaction
@@ -27,13 +37,13 @@ keeps `since` at the frontier.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
 
 from ..ops.consolidate import consolidate, consolidate_sorted
-from ..ops.lanes import key_lanes
+from ..ops.lanes import hash_pair, key_lanes
 from ..ops.merge import merge_sorted
 from ..ops.search import lex_searchsorted
 from ..ops.sort import apply_perm, sort_perm
@@ -47,21 +57,22 @@ class Arrangement:
     """A collection arranged (sorted) by a key-column prefix.
 
     batch: consolidated (no duplicate rows, nonzero diffs), sorted by
-    key lanes then remaining column lanes. Times in the batch are all
-    forwarded to the arrangement's logical `since` (full logical
-    compaction), so `batch` is exactly the accumulated multiset.
+    the order mode's lanes. Times in the batch are all forwarded to
+    the arrangement's logical `since` (full logical compaction), so
+    `batch` is exactly the accumulated multiset.
     """
 
     batch: Batch
     key: tuple  # static: key column indices
+    order: str = "exact"  # static: "exact" | "hash"
 
     def tree_flatten(self):
-        return (self.batch,), (self.key,)
+        return (self.batch,), (self.key, self.order)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        (key,) = aux
-        return cls(children[0], key)
+        key, order = aux
+        return cls(children[0], key, order)
 
     @property
     def schema(self) -> Schema:
@@ -72,43 +83,72 @@ class Arrangement:
         return self.batch.capacity
 
     def sort_lanes(self):
-        """Lanes defining this arrangement's order: key cols first, then
-        all remaining cols (so equal-key rows have deterministic order)."""
+        """Lanes defining this arrangement's order.
+
+        exact: key cols then all remaining cols (equal-key rows in
+        deterministic SQL-lane order).
+        hash: (key hash pair, full-row hash pair) — 4 lanes total."""
         rest = [
             i for i in range(self.schema.arity) if i not in self.key
         ]
+        if self.order == "hash":
+            kh1, kh2 = hash_pair(key_lanes(self.batch, self.key))
+            rh1, rh2 = hash_pair(
+                key_lanes(self.batch, list(self.key) + rest)
+            )
+            return [kh1, kh2, rh1, rh2]
         return key_lanes(self.batch, list(self.key) + rest)
 
     def key_only_lanes(self):
+        if self.order == "hash":
+            kh1, kh2 = hash_pair(key_lanes(self.batch, self.key))
+            return [kh1, kh2]
         return key_lanes(self.batch, list(self.key))
 
+    def probe_lanes(self, batch: Batch, cols):
+        """Lanes for probing THIS arrangement with `batch`'s `cols` —
+        must match the arrangement's order mode."""
+        if self.order == "hash":
+            kh1, kh2 = hash_pair(key_lanes(batch, cols))
+            return [kh1, kh2]
+        return key_lanes(batch, cols)
+
     @staticmethod
-    def empty(schema: Schema, key, capacity: int = 256) -> "Arrangement":
-        return Arrangement(Batch.empty(schema, capacity), tuple(key))
+    def empty(
+        schema: Schema, key, capacity: int = 256, order: str = "exact"
+    ) -> "Arrangement":
+        return Arrangement(
+            Batch.empty(schema, capacity), tuple(key), order
+        )
 
     def map_batches(self, fn) -> "Arrangement":
         """Rebuild with ``fn`` applied to the contained batch (shared
         shape-management protocol with Spine: replication, count
         reshaping, growth)."""
-        return Arrangement(fn(self.batch), self.key)
+        return Arrangement(fn(self.batch), self.key, self.order)
 
 
-def arrange(batch: Batch, key, capacity: int | None = None) -> Arrangement:
+def arrange(
+    batch: Batch, key, capacity: int | None = None, order: str = "exact"
+) -> Arrangement:
     """Sort+consolidate a batch into an Arrangement (build from scratch)."""
     key = tuple(key)
     cons = consolidate(batch, include_time=False)
-    if key == tuple(range(len(key))):
-        # Key is a schema prefix: consolidate's full-row sort order
-        # (schema order) IS the arrangement order — skip the re-sort
-        # (sort compiles are the TPU cost center).
+    # consolidate's output is in full-row HASH order; a hash-mode
+    # arrangement whose key is every column IN SCHEMA ORDER is
+    # therefore already sorted (its key hash is computed over the same
+    # lane sequence as consolidate's row hash) — the common
+    # output-index case skips its re-sort entirely. A PERMUTED
+    # full-column key hashes a different lane order and must re-sort.
+    if order == "hash" and key == tuple(range(batch.schema.arity)):
         sorted_batch = cons
     else:
-        arr = Arrangement(cons, key)
+        arr = Arrangement(cons, key, order)
         perm = sort_perm(arr.sort_lanes(), cons.count, cons.capacity)
         sorted_batch = apply_perm(cons, perm)
     if capacity is not None and capacity != sorted_batch.capacity:
         sorted_batch = sorted_batch.with_capacity(capacity)
-    return Arrangement(sorted_batch, key)
+    return Arrangement(sorted_batch, key, order)
 
 
 def insert(
@@ -120,7 +160,7 @@ def insert(
     (a tier >= expected survivors); on overflow retry with a larger tier —
     the exert-proportionality analog is that we always fully compact.
     """
-    d = arrange(delta, arr.key, capacity=None)
+    d = arrange(delta, arr.key, capacity=None, order=arr.order)
     merged, overflow = merge_sorted(
         arr.batch,
         arr.sort_lanes(),
@@ -129,18 +169,17 @@ def insert(
         out_capacity,
     )
     # Merged runs may contain the same row twice (once per side); both
-    # sides are sorted by the arrangement's sort lanes, so the merge is
-    # too, and summing duplicate rows' diffs needs NO sort
-    # (consolidate_sorted) — the arrangement's maintenance cost compiles
-    # linearly in its capacity, so state can scale to 2^20+ rows while
-    # sorts stay confined to delta-sized batches (PERF_NOTES.md fact 4).
-    m = Arrangement(merged, arr.key)
-    cons = consolidate_sorted(merged, m.sort_lanes())
-    return Arrangement(cons, arr.key), overflow
+    # sides share the arrangement's order, so equal rows are adjacent
+    # in the merge and duplicate summation needs NO sort
+    # (consolidate_sorted's exact adjacent comparison).
+    cons = consolidate_sorted(merged)
+    return Arrangement(cons, arr.key, arr.order), overflow
 
 
 def lookup_range(arr: Arrangement, probe_lanes) -> tuple:
-    """For each probe key, the [lo, hi) row range of matching keys."""
+    """For each probe key, the [lo, hi) row range of matching keys.
+    `probe_lanes` must come from Arrangement.probe_lanes (same order
+    mode)."""
     lanes = arr.key_only_lanes()
     lo = lex_searchsorted(lanes, arr.batch.count, probe_lanes, side="left")
     hi = lex_searchsorted(lanes, arr.batch.count, probe_lanes, side="right")
@@ -153,9 +192,9 @@ class Spine:
     """Amortized two-run arrangement: ``base`` (large, consolidated) plus
     ``tail`` (small, absorbs per-step deltas). Logical content is the
     multiset sum of both runs; each run is individually sorted by the
-    arrangement order (key columns then remaining columns) and
-    consolidated, but the SAME row may appear in both runs — readers
-    must combine (probe both runs; sum diffs downstream).
+    order mode's lanes and consolidated, but the SAME row may appear in
+    both runs — readers must combine (probe both runs; sum diffs
+    downstream).
 
     The point: per-step insert cost is O(tail capacity), independent of
     state size, so a 2^20-row arrangement can absorb 4k-row deltas
@@ -168,14 +207,15 @@ class Spine:
     base: Batch
     tail: Batch
     key: tuple  # static: key column indices
+    order: str = "exact"  # static: "exact" | "hash"
 
     def tree_flatten(self):
-        return (self.base, self.tail), (self.key,)
+        return (self.base, self.tail), (self.key, self.order)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        (key,) = aux
-        return cls(children[0], children[1], key)
+        key, order = aux
+        return cls(children[0], children[1], key, order)
 
     @property
     def schema(self) -> Schema:
@@ -193,21 +233,26 @@ class Spine:
     def runs(self) -> tuple[Arrangement, Arrangement]:
         """Single-run views for lookup/probe code (base first)."""
         return (
-            Arrangement(self.base, self.key),
-            Arrangement(self.tail, self.key),
+            Arrangement(self.base, self.key, self.order),
+            Arrangement(self.tail, self.key, self.order),
         )
 
     def map_batches(self, fn) -> "Spine":
-        return Spine(fn(self.base), fn(self.tail), self.key)
+        return Spine(fn(self.base), fn(self.tail), self.key, self.order)
 
     @staticmethod
     def empty(
-        schema: Schema, key, capacity: int = 256, tail_capacity: int = 1024
+        schema: Schema,
+        key,
+        capacity: int = 256,
+        tail_capacity: int = 1024,
+        order: str = "exact",
     ) -> "Spine":
         return Spine(
             Batch.empty(schema, capacity),
             Batch.empty(schema, tail_capacity),
             tuple(key),
+            order,
         )
 
 
@@ -218,8 +263,8 @@ def insert_tail(spine: Spine, delta: Batch) -> tuple[Spine, jnp.ndarray]:
 
     Returns (new_spine, tail_overflowed). On overflow the host grows the
     tail tier (or compacts more often) and replays."""
-    d = arrange(delta, spine.key, capacity=None)
-    tail_arr = Arrangement(spine.tail, spine.key)
+    d = arrange(delta, spine.key, capacity=None, order=spine.order)
+    tail_arr = Arrangement(spine.tail, spine.key, spine.order)
     merged, overflow = merge_sorted(
         spine.tail,
         tail_arr.sort_lanes(),
@@ -227,17 +272,18 @@ def insert_tail(spine: Spine, delta: Batch) -> tuple[Spine, jnp.ndarray]:
         d.sort_lanes(),
         spine.tail.capacity,
     )
-    m = Arrangement(merged, spine.key)
-    cons = consolidate_sorted(merged, m.sort_lanes())
-    return Spine(spine.base, cons, spine.key), overflow
+    cons = consolidate_sorted(merged)
+    return Spine(spine.base, cons, spine.key, spine.order), overflow
 
 
 def compact_spine(spine: Spine) -> tuple[Spine, jnp.ndarray]:
     """Merge the tail into the base: the amortized O(base) spine merge,
     dispatched by the host every K steps (and before peeks/snapshots).
-    Sort-free: both runs are sorted by the same lanes, so the merge is a
-    merge-path scatter + consolidate_sorted — compile cost stays flat in
-    state capacity (PERF_NOTES.md fact 4 is about sorts, not scatters).
+    Sort-free: both runs share the spine's order, so the merge is a
+    binary-search + one row-gather per dtype family, and duplicate
+    summation is the exact adjacent comparison (no sort at state
+    capacity — XLA's TPU sort compile is superlinear in rows and
+    operands, PERF_NOTES.md).
 
     Returns (new_spine with empty tail, base_overflowed)."""
     base_arr, tail_arr = spine.runs()
@@ -248,7 +294,6 @@ def compact_spine(spine: Spine) -> tuple[Spine, jnp.ndarray]:
         tail_arr.sort_lanes(),
         spine.base.capacity,
     )
-    m = Arrangement(merged, spine.key)
-    cons = consolidate_sorted(merged, m.sort_lanes())
+    cons = consolidate_sorted(merged)
     empty_tail = spine.tail.replace(count=jnp.zeros_like(spine.tail.count))
-    return Spine(cons, empty_tail, spine.key), overflow
+    return Spine(cons, empty_tail, spine.key, spine.order), overflow

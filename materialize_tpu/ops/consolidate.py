@@ -2,10 +2,17 @@
 
 The fundamental normal form of differential collections (reference:
 differential's `consolidate`, used pervasively; e.g. union consolidation at
-compute/src/render.rs:1336+). On TPU: lex-sort by full-row lanes, segmented
-sum of diffs, keep only segment leaders with nonzero accumulated diff,
-compact to a prefix.
-"""
+compute/src/render.rs:1336+).
+
+TPU form (round-5 redesign, PERF_NOTES.md): sort by a HASH PAIR of the
+row (2 sort operands instead of one per column — sort compile time is
+superlinear in operand count), then detect segment boundaries with
+EXACT full-row lane comparison on adjacent rows (cheap elementwise, so
+correctness never depends on hash uniqueness: a collision can only
+place two different rows next to each other, never merge them), sum
+diffs per segment with scan+gather (no output-sized scatter-add), keep
+segment leaders with nonzero totals, compact to a prefix (one
+row-scatter per dtype family)."""
 
 from __future__ import annotations
 
@@ -13,45 +20,70 @@ import jax
 import jax.numpy as jnp
 
 from ..repr.batch import Batch
-from .lanes import row_lanes
-from .sort import apply_perm, compact, segment_ids, segment_starts, sort_perm
+from .lanes import hash_pair, row_lanes
+from .sort import apply_perm, compact, sort_perm
 
 
 def consolidate(batch: Batch, include_time: bool = True) -> Batch:
-    """Return an equivalent batch in consolidated normal form."""
+    """Return an equivalent batch in consolidated normal form (hash
+    order — any total order on row content works for consolidation,
+    and hash-ordered arrangements share it so their merges stay
+    sort-free)."""
     cap = batch.capacity
-    lanes = row_lanes(batch, include_time=include_time)
-    perm = sort_perm(lanes, batch.count, cap)
+    h1, h2 = hash_pair(row_lanes(batch, include_time=False))
+    ops = [h1, h2]
+    if include_time:
+        ops.append(batch.time.astype(jnp.uint64))
+    perm = sort_perm(ops, batch.count, cap)
     sorted_batch = apply_perm(batch, perm)
-    # Permute the already-computed lanes instead of re-encoding every column.
-    lanes = [l[perm] for l in lanes]
-    return _consolidate_on_lanes(sorted_batch, lanes)
+    return _consolidate_adjacent(sorted_batch, include_time)
 
 
-def consolidate_sorted(batch: Batch, lanes) -> Batch:
-    """Consolidate a batch that is ALREADY sorted by `lanes`, where the
-    lanes cover every column (any full-row lexicographic order works:
-    equal rows are adjacent under any total order on all columns). No
-    sort — compile cost stays linear in capacity, which is what lets
-    arrangement state capacity scale to 2^20+ (XLA's TPU sort compile is
-    superlinear in rows; PERF_NOTES.md fact 4). The spine merge path
-    (`arrangement/spine.py insert`) is the intended caller: a merge of
-    two sorted runs is sorted, so its duplicate-row summation needs no
-    re-sort."""
-    return _consolidate_on_lanes(batch, lanes)
+def consolidate_sorted(batch: Batch, include_time: bool = False) -> Batch:
+    """Consolidate a batch whose equal rows are already ADJACENT (any
+    total order on row content puts them there — the hash order and
+    the exact arrangement orders all qualify). No sort; equality is
+    the exact adjacent-row comparison. The spine merge path is the
+    intended caller: a merge of two same-order runs preserves
+    adjacency of equal rows."""
+    return _consolidate_adjacent(batch, include_time)
 
 
-def _consolidate_on_lanes(sorted_batch: Batch, lanes) -> Batch:
-    cap = sorted_batch.capacity
-    starts = segment_starts(lanes, sorted_batch.count, cap)
-    seg = segment_ids(starts)
-    valid = sorted_batch.valid_mask()
-    diffs = jnp.where(valid, sorted_batch.diff, 0)
-    # Sum diffs within each segment; scatter-add into per-segment slots.
-    seg_sums = jnp.zeros(cap, dtype=diffs.dtype).at[seg].add(
-        diffs, mode="drop"
+def _segment_totals(starts, diffs):
+    """Per-row total of its segment's diffs, via scans + two gathers
+    (an output-sized scatter-add costs ~2x a gather at state scale;
+    PERF_NOTES.md round-5 table)."""
+    n = starts.shape[0]
+    j = jnp.arange(n, dtype=jnp.int32)
+    start_pos = jax.lax.cummax(jnp.where(starts, j, 0))
+    # Last row of each segment = the row whose successor is a start
+    # (or the final row). Reversed cummax finds, for every row, the
+    # nearest segment-last at or after it.
+    is_last = jnp.roll(starts, -1).at[-1].set(True)
+    end_pos = jnp.flip(
+        jax.lax.cummin(jnp.flip(jnp.where(is_last, j, n - 1)))
     )
-    row_total = seg_sums[seg]
+    cs = jnp.cumsum(diffs)
+    upper = cs[jnp.clip(end_pos, 0, n - 1)]
+    lower = jnp.where(
+        start_pos > 0, cs[jnp.clip(start_pos - 1, 0, n - 1)], 0
+    )
+    return upper - lower
+
+
+def _consolidate_adjacent(sorted_batch: Batch, include_time: bool) -> Batch:
+    cap = sorted_batch.capacity
+    ex_lanes = row_lanes(sorted_batch, include_time=include_time)
+    valid = sorted_batch.valid_mask()
+    # Exact adjacent-equality boundaries.
+    starts = jnp.ones(cap, dtype=bool)
+    if cap > 1:
+        same = jnp.ones(cap - 1, dtype=bool)
+        for l in ex_lanes:
+            same = jnp.logical_and(same, l[1:] == l[:-1])
+        starts = starts.at[1:].set(jnp.logical_not(same))
+    diffs = jnp.where(valid, sorted_batch.diff, 0)
+    row_total = _segment_totals(starts, diffs)
     keep = jnp.logical_and(starts, row_total != 0)
     out = sorted_batch.replace(diff=jnp.where(starts, row_total, 0))
     return compact(out, keep)

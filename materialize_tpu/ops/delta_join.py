@@ -191,6 +191,10 @@ class DeltaJoinOp:
             probe_lanes.extend(column_lanes(acc.cols[i], col.ctype))
         if not probe_lanes:
             probe_lanes = [jnp.zeros(acc.capacity, dtype=jnp.uint64)]
+        if spine.order == "hash":
+            from .lanes import hash_pair
+
+            probe_lanes = list(hash_pair(probe_lanes))
         acc = acc.replace(diff=diff)
         outs, ovfs = [], []
         for arr in spine.runs():

@@ -141,7 +141,7 @@ class JoinOp:
         column order. Probes both runs of the spine; a row value present
         in both runs (with cancelling diffs) yields matches from both,
         which downstream consolidation cancels — multiset semantics."""
-        probe_lanes = key_lanes(delta, delta_key)
+        probe_lanes = spine.runs()[0].probe_lanes(delta, delta_key)
         outs, ovfs = [], []
         for arr in spine.runs():
             out, ovf = self._probe_run(

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..repr.batch import Batch
-from ..repr.schema import ColumnType
+from ..repr.schema import Column, ColumnType
 
 # numpy scalars, not jnp: a module-level jnp constant would
 # initialize the JAX backend (and contact the TPU tunnel) at import.
@@ -170,13 +170,83 @@ def row_lanes(batch: Batch, include_time: bool = True) -> list[jnp.ndarray]:
     return lanes
 
 
-def hash_lanes(lanes) -> jnp.ndarray:
+def hash_lanes(lanes, seed: int = 0x9E3779B97F4A7C15) -> jnp.ndarray:
     """Mix lanes into a single uint64 hash (for exchange routing, not
     identity). Analog of the Exchange pact's key hash
     (timely columnar_exchange)."""
-    h = jnp.full(lanes[0].shape, jnp.uint64(0x9E3779B97F4A7C15))
+    h = jnp.full(lanes[0].shape, jnp.uint64(seed))
     for lane in lanes:
         h = h ^ (lane + jnp.uint64(0x9E3779B97F4A7C15) + (h << jnp.uint64(6)) + (h >> jnp.uint64(2)))
         h = h * jnp.uint64(0xBF58476D1CE4E5B9)
         h = h ^ (h >> jnp.uint64(27))
     return h
+
+
+# Second-stream seed for the hash-pair order (any odd constant distinct
+# from hash_lanes' default works; fixed so host generators can replicate
+# the order with numpy).
+_HASH2_SEED = 0xC2B2AE3D27D4EB4F
+
+
+def hash_pair(lanes) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Two independent 64-bit hashes of a lane tuple — the HASH ORDER
+    used by consolidation and big arrangements (round-5 redesign,
+    PERF_NOTES.md): sorting/merging by (h1, h2) needs 2 sort operands
+    and 2 search lanes instead of one per column, which is what makes
+    sorts compile and searches execute at state scale. Equality remains
+    EXACT everywhere: consumers compare full exact lanes on ADJACENT
+    rows (cheap elementwise) — the hash pair only fixes a consistent
+    total order, so a collision can at worst place two different rows
+    next to each other, never merge them."""
+    return hash_lanes(lanes), hash_lanes(lanes, seed=_HASH2_SEED)
+
+
+def hash_pair_host(cols_u64: list) -> tuple:
+    """Numpy replica of hash_pair over pre-encoded u64 lane arrays, so
+    host-side producers (load generators) can emit batches PRE-SORTED
+    in the device hash order (sorted ingest skips device sorts)."""
+    import numpy as np
+
+    def mix(seed):
+        h = np.full(cols_u64[0].shape, np.uint64(seed))
+        with np.errstate(over="ignore"):
+            for lane in cols_u64:
+                lane = lane.astype(np.uint64)
+                h = h ^ (
+                    lane
+                    + np.uint64(0x9E3779B97F4A7C15)
+                    + (h << np.uint64(6))
+                    + (h >> np.uint64(2))
+                )
+                h = h * np.uint64(0xBF58476D1CE4E5B9)
+                h = h ^ (h >> np.uint64(27))
+        return h
+
+    return mix(0x9E3779B97F4A7C15), mix(_HASH2_SEED)
+
+
+def host_lane_encode(col, column: "Column", nulls=None):
+    """Numpy replica of key_lanes' per-column encoding (FLOAT64
+    unsupported — host presort callers are integer generators).
+    Matches the device exactly, including the schema-driven null lane:
+    a NULLABLE column always contributes a leading null lane (all-ones
+    when no runtime mask is present), lane arity being a function of
+    the schema alone. Returns list of u64 arrays."""
+    import numpy as np
+
+    ctype = column.ctype
+    if ctype is ColumnType.FLOAT64:
+        raise NotImplementedError("host lane encode: float64")
+    if ctype is ColumnType.BOOL:
+        v = col.astype(np.uint64)
+    else:
+        v = col.astype(np.int64).astype(np.uint64) ^ np.uint64(1 << 63)
+    if not column.nullable:
+        return [v]
+    if nulls is None:
+        return [np.ones(len(col), dtype=np.uint64), v]
+    nl = nulls.astype(bool)
+    return [
+        np.where(nl, np.uint64(0), np.uint64(1)),
+        np.where(nl, np.uint64(0), v),
+    ]

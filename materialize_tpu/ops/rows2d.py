@@ -1,0 +1,110 @@
+"""Row-major field groups: move whole rows with ONE gather.
+
+TPU gather/scatter cost is per-INDEX, nearly independent of row width
+(measured: a [2M, 8] and a [2M, 128] row-gather both cost ~28-42ns/row,
+the same as a single 1-D gather — PERF_NOTES.md round-5 table). So any
+data-dependent movement of a batch (merge, compact, permute) should
+stack its fields into a [cap, F] array per dtype family, move ROWS
+once, and unstack — instead of paying one gather/scatter per field
+(the round 1-4 design: 30+ scatters made a 2M-row spine merge cost
+8.3s; the row-group form costs ~0.15s).
+
+Two dtype families cover every column type (repr/schema.py): the "i"
+family (bool/int32/int64/uint64 and null lanes, all round-trippable
+through int64) and the "f" family (float64). The reference's analog is
+its byte-row representation (repr/src/row.rs) — contiguous rows moved
+as units — recast columnar: we keep struct-of-arrays at rest and go
+row-major only inside a movement kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..repr.batch import Batch
+
+
+def _fields(batch: Batch):
+    """Ordered (family, array, restore) descriptors for every non-None
+    field of a batch. `restore` rebuilds the original dtype."""
+    out = []
+    for c, col in zip(batch.schema.columns, batch.cols):
+        if col.dtype == jnp.float64:
+            out.append(("f", col, None))
+        else:
+            dt = col.dtype
+            out.append(("i", col.astype(jnp.int64), dt))
+    for nl in batch.nulls:
+        if nl is None:
+            out.append((None, None, None))
+        else:
+            out.append(("i", nl.astype(jnp.int64), jnp.bool_))
+    out.append(("i", batch.time.astype(jnp.int64), batch.time.dtype))
+    out.append(("i", batch.diff, batch.diff.dtype))
+    return out
+
+
+def to_groups(batch: Batch) -> dict:
+    """Stack a batch's fields into per-family [cap, F] arrays."""
+    groups: dict = {}
+    for fam, arr, _ in _fields(batch):
+        if fam is not None:
+            groups.setdefault(fam, []).append(arr)
+    return {
+        fam: jnp.stack(arrs, axis=1) for fam, arrs in groups.items()
+    }
+
+
+def from_groups(
+    groups: dict, like: Batch, count
+) -> Batch:
+    """Unstack per-family [cap, F] arrays back into a batch shaped like
+    `like` (same schema / null-presence), with the given count."""
+    cursors = {fam: 0 for fam in groups}
+
+    def take(fam, restore):
+        j = cursors[fam]
+        cursors[fam] = j + 1
+        a = groups[fam][:, j]
+        return a if restore is None else a.astype(restore)
+
+    descs = iter(_fields(like))
+    cols = []
+    for _ in like.cols:
+        fam, _, restore = next(descs)
+        cols.append(take(fam, restore))
+    nulls = []
+    for nl in like.nulls:
+        fam, _, restore = next(descs)
+        nulls.append(None if fam is None else take(fam, restore))
+    fam, _, restore = next(descs)
+    time = take(fam, restore)
+    fam, _, restore = next(descs)
+    diff = take(fam, restore)
+    return Batch(
+        cols=tuple(cols),
+        nulls=tuple(nulls),
+        time=time,
+        diff=diff,
+        count=count,
+        schema=like.schema,
+    )
+
+
+def gather_rows(groups: dict, idx) -> dict:
+    """Row-gather every family at the same indices."""
+    return {fam: g[idx] for fam, g in groups.items()}
+
+
+def scatter_rows(groups: dict, dest, out_capacity: int) -> dict:
+    """Row-scatter every family to `dest` (mode=drop) into zeroed
+    [out_capacity, F] outputs."""
+    out = {}
+    for fam, g in groups.items():
+        z = jnp.zeros((out_capacity, g.shape[1]), dtype=g.dtype)
+        out[fam] = z.at[dest].set(g, mode="drop")
+    return out
+
+
+def concat_groups(a: dict, b: dict) -> dict:
+    return {fam: jnp.concatenate([a[fam], b[fam]]) for fam in a}

@@ -30,23 +30,23 @@ def sort_perm(lanes, count, capacity: int) -> jnp.ndarray:
 
 
 def apply_perm(batch: Batch, perm: jnp.ndarray) -> Batch:
-    take = lambda a: None if a is None else a[perm]
-    return Batch(
-        cols=tuple(take(c) for c in batch.cols),
-        nulls=tuple(take(n) for n in batch.nulls),
-        time=batch.time[perm],
-        diff=batch.diff[perm],
-        count=batch.count,
-        schema=batch.schema,
-    )
+    """Reorder rows by `perm` — ONE row-gather per dtype family
+    (gather cost is per-index, independent of row width; rows2d.py)."""
+    from .rows2d import from_groups, gather_rows, to_groups
+
+    groups = gather_rows(to_groups(batch), perm)
+    return from_groups(groups, batch, batch.count)
 
 
 def compact(batch: Batch, keep: jnp.ndarray) -> Batch:
     """Drop rows where `keep` is False, moving survivors to a contiguous
     prefix (stable). `keep` is anded with the validity mask.
 
-    Scatter-based: positions via exclusive cumsum, out-of-range drops.
-    """
+    One row-scatter per dtype family: positions via exclusive cumsum,
+    out-of-range drops (rows2d.py — the per-field form cost one
+    output-sized scatter per field)."""
+    from .rows2d import from_groups, scatter_rows, to_groups
+
     if keep.shape[0] == 0:  # capacity-0 batch: nothing to do
         return batch
     keep = jnp.logical_and(keep, batch.valid_mask())
@@ -54,21 +54,8 @@ def compact(batch: Batch, keep: jnp.ndarray) -> Batch:
     new_count = (pos[-1] + 1).astype(jnp.int32)
     cap = batch.capacity
     dest = jnp.where(keep, pos, cap)  # cap is out of range -> dropped
-
-    def scatter(a):
-        if a is None:
-            return None
-        out = jnp.zeros_like(a)
-        return out.at[dest].set(a, mode="drop")
-
-    return Batch(
-        cols=tuple(scatter(c) for c in batch.cols),
-        nulls=tuple(scatter(n) for n in batch.nulls),
-        time=scatter(batch.time),
-        diff=scatter(batch.diff),
-        count=new_count,
-        schema=batch.schema,
-    )
+    groups = scatter_rows(to_groups(batch), dest, cap)
+    return from_groups(groups, batch, new_count)
 
 
 def concat_batches(batches: list[Batch]) -> Batch:

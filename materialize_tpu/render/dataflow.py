@@ -883,10 +883,14 @@ class _DataflowBase:
         # The output index is a two-run Spine: per-step inserts touch
         # only the tail; scheduled compactions fold the tail into the
         # base (so an index over a 2^20-row collection costs O(tail)
-        # per step, not O(state)).
+        # per step, not O(state)). HASH order: the output index serves
+        # consolidation and full scans, never in-range value order, so
+        # it rides the 2-lane hash order that keeps state-scale merges
+        # sort-free and search-cheap (spine.py order modes).
         self.output = Spine.empty(
             self.out_schema, out_key, capacity,
             tail_capacity=self._ctx.out_delta_cap,
+            order="hash",
         )
         # The err collection: scalar-evaluation errors maintained next
         # to the data output (ok/err pair, render.rs:12-101). Reads
@@ -901,10 +905,16 @@ class _DataflowBase:
         # round 1 (PERF_NOTES.md).
         self._time_dev = None
         # Deferred-overflow-check bookkeeping (see run_steps/check_flags).
+        # Flags accumulate as a running ON-DEVICE logical_or — one tiny
+        # array regardless of how many steps are deferred. (Keeping a
+        # per-step list and stacking at check time built a program with
+        # one operand PER DEFERRED STEP; at ~500 steps that program took
+        # tens of minutes to build+run through the remote-TPU tunnel —
+        # the actual cause of rounds 3/4's driver bench timeouts.)
         self._defer_ck = None
         self._defer_log: list = []
-        self._defer_flags: list = []
-        self._defer_cflags: list = []
+        self._defer_flags = None
+        self._defer_cflags = None
         # Spine-compaction schedule: every K steps the host dispatches
         # one compact program that merges every spine's tail into its
         # base (the amortized O(state) merge; differential's spine-merge
@@ -939,19 +949,23 @@ class _DataflowBase:
             [jnp.asarray(ovf[k]).astype(jnp.bool_).reshape(()) for k in keys]
         )
 
-    def _grow_for(self, key) -> None:
-        """Grow the capacity tier behind an overflowed key."""
+    def _grow_for(self, key, target: int | None = None) -> None:
+        """Grow the capacity tier behind an overflowed key — one
+        doubling by default, or straight to ``target`` in a single pad
+        (callers applying known steady-state tiers up front skip the
+        doubling ladder, whose per-rung pad programs each cost a compile
+        + dispatch through the TPU tunnel)."""
         if key[0] == "state":
             _, slot, part = key
             parts = list(self.states[slot])
             if isinstance(part, tuple):  # spine sub-run: (part, which)
                 p, which = part
-                parts[p] = self._grow_spine(parts[p], which)
+                parts[p] = self._grow_spine(parts[p], which, target)
             else:
-                parts[part] = self._grow_arrangement(parts[part])
+                parts[part] = self._grow_arrangement(parts[part], target)
             self.states[slot] = tuple(parts)
         elif key[0] == "out":
-            self.output = self._grow_spine(self.output, key[1])
+            self.output = self._grow_spine(self.output, key[1], target)
         elif key[0] == "join":
             self._ctx.join_caps[key[1]] *= 2
             self._remake_jit()
@@ -965,20 +979,30 @@ class _DataflowBase:
             self._ctx.out_delta_cap *= 2
             self._remake_jit()
         elif key[0] == "errout":
-            self.err_output = self._grow_arrangement(self.err_output)
+            self.err_output = self._grow_arrangement(
+                self.err_output, target
+            )
         else:
             raise AssertionError(f"unknown overflow key {key}")
 
-    def _grow_arrangement(self, arr: Arrangement) -> Arrangement:
-        return arr.map_batches(self._grow_batch)
+    def _grow_arrangement(
+        self, arr: Arrangement, target: int | None = None
+    ) -> Arrangement:
+        return arr.map_batches(lambda b: self._grow_batch(b, target))
 
-    def _grow_spine(self, spine: Spine, which: str) -> Spine:
+    def _grow_spine(
+        self, spine: Spine, which: str, target: int | None = None
+    ) -> Spine:
         if which == "base":
             return Spine(
-                self._grow_batch(spine.base), spine.tail, spine.key
+                self._grow_batch(spine.base, target), spine.tail,
+                spine.key, spine.order,
             )
         assert which == "tail", which
-        return Spine(spine.base, self._grow_batch(spine.tail), spine.key)
+        return Spine(
+            spine.base, self._grow_batch(spine.tail, target),
+            spine.key, spine.order,
+        )
 
     def step(self, inputs: dict) -> Batch:
         """Feed one micro-batch of updates per source; returns the output
@@ -1101,15 +1125,22 @@ class _DataflowBase:
         )
         return tuple(new_states), new_out, packed
 
-    def _dispatch_span(self, packed: list, env) -> tuple[list, list, list]:
+    @staticmethod
+    def _or_acc(acc, fl):
+        """Fold one packed flag array into the running on-device OR."""
+        if acc is None:
+            return fl
+        return jnp.logical_or(acc, fl)
+
+    def _dispatch_span(self, packed: list, env):
         """Asynchronously dispatch one step per packed input, plus the
         scheduled spine compactions. ZERO host transfers: time rides as
-        a device scalar (created once per dataflow), overflow flags stay
-        on device for the caller to check. Returns (deltas, per-step
-        flag arrays, per-compaction flag arrays)."""
+        a device scalar (created once per dataflow), overflow flags
+        accumulate as a running on-device logical_or for the caller to
+        check. Returns (deltas, step-flag OR, compaction-flag OR)."""
         if self._time_dev is None:
             self._time_dev = jnp.asarray(self.time, dtype=jnp.uint64)
-        deltas, flags, cflags = [], [], []
+        deltas, flags_or, cflags_or = [], None, None
         for p in packed:
             args = (
                 tuple(self.states),
@@ -1132,33 +1163,36 @@ class _DataflowBase:
             self._time_dev = new_t
             self._time += 1  # direct: keep the device carry live
             deltas.append(out)
-            flags.append(fl)
+            flags_or = self._or_acc(flags_or, fl)
             self._steps_since_compact += 1
             if self._steps_since_compact >= self._compact_every:
-                cflags.append(self._dispatch_compact())
+                cflags_or = self._or_acc(
+                    cflags_or, self._dispatch_compact()
+                )
                 self._steps_since_compact = 0
-        return deltas, flags, cflags
+        return deltas, flags_or, cflags_or
 
-    def _read_flags(self, flags: list, keys: list) -> np.ndarray:
-        """One d2h readback of packed overflow flags for a span.
+    def _read_flags(self, flags_or, keys: list) -> np.ndarray:
+        """One tiny d2h readback of the OR-accumulated overflow flags.
         NOTE: through the remote-TPU tunnel, the FIRST d2h readback in a
         process permanently switches dispatch from pipelined-async to
         synchronous round-trips (~10 ms/dispatch; measured, see
         PERF_NOTES.md). Latency-critical paths defer this via
         run_steps(defer_check=True) + check_flags()."""
-        if flags and keys:
-            fh = np.asarray(jnp.stack(flags))  # [K, nkeys] or [K, nkeys, P]
-            per_key = fh.reshape(fh.shape[0], len(keys), -1)
-            return per_key.any(axis=(0, 2))
+        if flags_or is not None and keys:
+            fh = np.asarray(flags_or)  # [nkeys] or [nkeys, P]
+            return fh.reshape(len(keys), -1).any(axis=1)
         return np.zeros(len(keys) if keys else 0, dtype=bool)
 
-    def _overflowed_keys(self, flags: list, cflags: list) -> list:
+    def _overflowed_keys(self, flags_or, cflags_or) -> list:
         """Read both flag groups (steps + compactions); returns the list
         of overflowed tier keys."""
         out = []
-        for i in np.nonzero(self._read_flags(flags, self._ovf_keys))[0]:
+        for i in np.nonzero(self._read_flags(flags_or, self._ovf_keys))[0]:
             out.append(self._ovf_keys[i])
-        for i in np.nonzero(self._read_flags(cflags, self._covf_keys))[0]:
+        for i in np.nonzero(
+            self._read_flags(cflags_or, self._covf_keys)
+        )[0]:
             out.append(self._covf_keys[i])
         return out
 
@@ -1170,7 +1204,7 @@ class _DataflowBase:
             ck = self._checkpoint()
             cfl = self._dispatch_compact()
             self._steps_since_compact = 0
-            over = self._read_flags([cfl], self._covf_keys)
+            over = self._read_flags(cfl, self._covf_keys)
             if not over.any():
                 return
             self._restore(ck)
@@ -1226,10 +1260,16 @@ class _DataflowBase:
         if defer_check:
             if self._defer_ck is None:
                 self._defer_ck = self._checkpoint()
-            deltas, flags, cflags = self._dispatch_span(packed, env)
+            deltas, flags_or, cflags_or = self._dispatch_span(packed, env)
             self._defer_log.append((packed, env))
-            self._defer_flags.extend(flags)
-            self._defer_cflags.extend(cflags)
+            if flags_or is not None:
+                self._defer_flags = self._or_acc(
+                    self._defer_flags, flags_or
+                )
+            if cflags_or is not None:
+                self._defer_cflags = self._or_acc(
+                    self._defer_cflags, cflags_or
+                )
             return deltas
         self.check_flags()
         while True:
@@ -1243,6 +1283,141 @@ class _DataflowBase:
                 continue
             return deltas
 
+    # -- span-scan execution ------------------------------------------------
+    #
+    # Through the remote-TPU tunnel every dispatch+block round trip
+    # costs ~96ms, paid serially (PERF_NOTES.md round 5). A per-step
+    # host loop is therefore RTT-bound at ~10 steps/s regardless of
+    # device speed. run_span executes K steps as ONE device program —
+    # lax.scan chunks of `_compact_every` steps with the spine
+    # compaction traced BETWEEN chunks — so a span pays one RTT total.
+    # This is also the TPU-native shape independent of the tunnel: the
+    # micro-batch loop is control flow, and control flow belongs on
+    # device (lax.scan), not in Python.
+
+    def _stack_packed(self, packed_list: list) -> dict:
+        """Stack K per-step input dicts into one dict of batches with
+        [K, ...] leaves (the scan's xs)."""
+        out = {}
+        for name in packed_list[0]:
+            bs = [p[name] for p in packed_list]
+            leaves0, treedef = jax.tree_util.tree_flatten(bs[0])
+            leavess = [jax.tree_util.tree_flatten(b)[0] for b in bs]
+            stacked = [
+                jnp.stack([lv[i] for lv in leavess])
+                for i in range(len(leaves0))
+            ]
+            out[name] = jax.tree_util.tree_unflatten(treedef, stacked)
+        return out
+
+    def _make_span_jit(self, n_chunks: int, with_env: bool):
+        ce = self._compact_every
+
+        def span(states, output, err_output, time_dev, stacked, *env_a):
+            env = env_a[0] if env_a else None
+
+            def body(carry, xs):
+                st, o, e, t = carry
+                if env is not None:
+                    out, ns, no, ne, nt, fl = self._step_core(
+                        st, o, e, xs, t, env
+                    )
+                else:
+                    out, ns, no, ne, nt, fl = self._step_core(
+                        st, o, e, xs, t
+                    )
+                return (ns, no, ne, nt), (out, fl)
+
+            carry = (tuple(states), output, err_output, time_dev)
+            sfl_or, cfl_or = None, None
+            delta_chunks = []
+            rest = stacked
+            for _ in range(n_chunks):
+                chunk = jax.tree_util.tree_map(lambda a: a[:ce], rest)
+                rest = jax.tree_util.tree_map(lambda a: a[ce:], rest)
+                carry, (deltas, fls) = jax.lax.scan(body, carry, chunk)
+                delta_chunks.append(deltas)
+                sfl = fls.any(axis=0)
+                sfl_or = sfl if sfl_or is None else jnp.logical_or(
+                    sfl_or, sfl
+                )
+                st, o, e, t = carry
+                ns2, no2, cfl = self._compact_core_single(st, o)
+                cfl_or = cfl if cfl_or is None else jnp.logical_or(
+                    cfl_or, cfl
+                )
+                carry = (tuple(ns2), no2, e, t)
+            deltas_all = jax.tree_util.tree_map(
+                lambda *xs: jnp.concatenate(xs), *delta_chunks
+            ) if len(delta_chunks) > 1 else delta_chunks[0]
+            return carry, deltas_all, sfl_or, cfl_or
+
+        return jax.jit(span)
+
+    def run_span(self, inputs_list: list):
+        """Feed a span of micro-batches as ONE device dispatch (deferred
+        overflow checks — see run_steps). The span length must be a
+        multiple of ``_compact_every``; spine compaction runs on device
+        between scan chunks. Returns the stacked per-step output deltas
+        (leaves shaped [K, ...], device-resident, PROVISIONAL until
+        check_flags)."""
+        ce = self._compact_every
+        if len(inputs_list) % ce != 0:
+            raise ValueError(
+                f"span length {len(inputs_list)} must be a multiple of "
+                f"compact_every={ce}"
+            )
+        if getattr(self, "_first_time", None) is None:
+            self._first_time = int(self.time)
+            self._ctx.first_time = self._first_time
+        # Checkpoint BEFORE any dispatch (including the flush
+        # compaction below): an overflow discovered at check_flags
+        # time must be able to roll all of it back.
+        if self._defer_ck is None:
+            self._defer_ck = self._checkpoint()
+        if self._steps_since_compact:
+            # Flush so the span's internal compaction schedule starts
+            # from a clean counter (deterministic with per-step paths).
+            cfl = self._dispatch_compact()
+            self._defer_cflags = self._or_acc(self._defer_cflags, cfl)
+            self._steps_since_compact = 0
+        packed = [self._pack_inputs(i) for i in inputs_list]
+        env = self._build_env()
+        if self._time_dev is None:
+            self._time_dev = jnp.asarray(self.time, dtype=jnp.uint64)
+        n_chunks = len(inputs_list) // ce
+        if not hasattr(self, "_span_jits"):
+            self._span_jits = {}
+        key = (ce, n_chunks, env is not None)
+        jitfn = self._span_jits.get(key)
+        if jitfn is None:
+            jitfn = self._make_span_jit(n_chunks, env is not None)
+            self._span_jits[key] = jitfn
+        stacked = self._stack_packed(packed)
+        args = (
+            tuple(self.states), self.output, self.err_output,
+            self._time_dev, stacked,
+        )
+        if env is not None:
+            carry, deltas, sfl, cfl = jitfn(*args, env)
+        else:
+            carry, deltas, sfl, cfl = jitfn(*args)
+        st, o, e, t = carry
+        self.states = list(st)
+        self.output = o
+        self.err_output = e
+        self._time_dev = t
+        self._time += len(inputs_list)
+        # Rollback/replay bookkeeping: replays reuse the ordinary
+        # per-step path (compaction timing differs, which is
+        # semantically transparent — compaction never changes content).
+        self._defer_log.append((packed, env))
+        if sfl is not None:
+            self._defer_flags = self._or_acc(self._defer_flags, sfl)
+        if cfl is not None:
+            self._defer_cflags = self._or_acc(self._defer_cflags, cfl)
+        return deltas
+
     def check_flags(self) -> bool:
         """Resolve deferred overflow checks: one flags readback covering
         every span dispatched with ``defer_check=True``. On overflow,
@@ -1250,14 +1425,15 @@ class _DataflowBase:
         and replays the logged spans synchronously. Returns whether any
         overflow occurred (callers timing the deferred spans use this to
         invalidate their measurement)."""
-        if not self._defer_flags and not self._defer_cflags:
+        if self._defer_flags is None and self._defer_cflags is None:
             self._defer_ck = None
             self._defer_log = []
             return False
         over = self._overflowed_keys(self._defer_flags, self._defer_cflags)
         log = self._defer_log
         ck = self._defer_ck
-        self._defer_log, self._defer_flags, self._defer_cflags = [], [], []
+        self._defer_log = []
+        self._defer_flags, self._defer_cflags = None, None
         self._defer_ck = None
         if not over:
             return False
@@ -1314,6 +1490,7 @@ class Dataflow(_DataflowBase):
         # side-tables as an extra jit input (expr/strings.py); others
         # keep the 4-argument signature (and their compile-cache
         # entries).
+        self._span_jits = {}
         if self._str_keys:
             self._step_jit = jax.jit(
                 lambda s, o, eo, i, t, env: self._step_core(
@@ -1325,8 +1502,9 @@ class Dataflow(_DataflowBase):
                 lambda s, o, eo, i, t: self._step_core(s, o, eo, i, t)
             )
 
-    def _grow_batch(self, b: Batch) -> Batch:
-        return b.with_capacity(b.capacity * 2)
+    def _grow_batch(self, b: Batch, target: int | None = None) -> Batch:
+        cap = target if target is not None else b.capacity * 2
+        return b.with_capacity(cap) if cap > b.capacity else b
 
     def _make_compact_jit(self):
         return jax.jit(self._compact_core_single)
@@ -1655,19 +1833,26 @@ class ShardedDataflow(_DataflowBase):
             schema=b.schema,
         )
 
-    def _grow_batch(self, b: Batch) -> Batch:
-        """Double every shard's capacity ([P, cap] -> [P, 2cap])."""
+    def _grow_batch(self, b: Batch, target: int | None = None) -> Batch:
+        """Grow every shard's capacity ([P, cap] -> [P, new_cap]):
+        doubled by default, or straight to a GLOBAL ``target`` capacity
+        (same units as b.capacity, i.e. P * per-shard)."""
         P_ = self.num_shards
         cap = b.capacity // P_
+        new_cap = (
+            -(-target // P_) if target is not None else cap * 2
+        )
+        if new_cap <= cap:
+            return b
 
         def grow(a):
             if a is None:
                 return None
             h = np.asarray(a).reshape(P_, cap)
-            out = np.zeros((P_, 2 * cap), dtype=h.dtype)
+            out = np.zeros((P_, new_cap), dtype=h.dtype)
             out[:, :cap] = h
             return jax.device_put(
-                out.reshape(P_ * 2 * cap), self._sharding
+                out.reshape(P_ * new_cap), self._sharding
             )
 
         return Batch(
@@ -1777,6 +1962,13 @@ class ShardedDataflow(_DataflowBase):
                 )(states, output, err_output, inputs, time)
 
         self._step_jit = jax.jit(step)
+
+    def run_span(self, inputs_list: list):
+        raise NotImplementedError(
+            "span-scan execution is single-device for now; sharded "
+            "dataflows use run_steps (the shard_map step is already "
+            "one dispatch per step)"
+        )
 
     def _make_compact_jit(self):
         axis = self.axis_name

@@ -213,3 +213,106 @@ def test_compaction_schedule_survives_overflow_replay():
     for r in df.peek():
         got[r[:-2]] = got.get(r[:-2], 0) + r[-1]
     assert {k: d for k, d in got.items() if d} == oracle
+
+
+def test_hash_spine_growth_preserves_order_mode():
+    """Regression (round 5): growing a hash-ordered spine's base via the
+    dataflow's _grow_spine must keep order='hash' — dropping it back to
+    'exact' made every post-growth merge use exact lanes over
+    hash-sorted runs (observed as wrong join results after the output
+    index's first base overflow)."""
+    from materialize_tpu.expr import relation as mir
+    from materialize_tpu.render.dataflow import Dataflow
+
+    df = Dataflow(mir.Get("L", SCH), state_cap=256)
+    assert df.output.order == "hash"
+    grown = df._grow_spine(df.output, "base")
+    assert grown.order == "hash"
+    grown = df._grow_spine(df.output, "tail")
+    assert grown.order == "hash"
+
+    # End-to-end: churn far past the initial base capacity with
+    # retractions; peeks (which force compactions and growth) must
+    # stay oracle-exact.
+    rng = np.random.default_rng(7)
+    oracle: dict = {}
+    for t in range(8):
+        n = 150
+        ks = rng.integers(0, 400, n)
+        vs = rng.integers(0, 3, n)
+        ds = rng.integers(-1, 2, n)
+        ds[ds == 0] = 1
+        for k, v, d in zip(ks, vs, ds):
+            key = (int(k), int(v))
+            oracle[key] = oracle.get(key, 0) + int(d)
+        df.step({"L": _batch(ks, vs, ds, t=t, cap=256)})
+        got: dict = {}
+        for r in df.peek():
+            got[r[:-2]] = got.get(r[:-2], 0) + r[-1]
+        assert {k: d for k, d in got.items() if d} == {
+            k: d for k, d in oracle.items() if d
+        }, f"diverged at step {t}"
+
+
+def test_hash_spine_tail_larger_than_base():
+    """Merging a tail whose CAPACITY exceeds the base/out capacity must
+    stay exact (the real output spine runs with tail=out_delta_cap=4096
+    over a small initial base)."""
+    rng = np.random.default_rng(3)
+    sp = Spine.empty(SCH, (0, 1), 512, 4096, order="hash")
+    ms: dict = {}
+    for t in range(6):
+        n = 60
+        ks = rng.integers(0, 30, n)
+        vs = rng.integers(0, 3, n)
+        ds = rng.integers(-1, 2, n)
+        ds[ds == 0] = 1
+        for k, v, d in zip(ks, vs, ds):
+            key = (int(k), int(v))
+            ms[key] = ms.get(key, 0) + int(d)
+        sp, ovf = insert_tail(sp, _batch(ks, vs, ds, t=t, cap=256))
+        assert not bool(ovf)
+        sp, ovf = compact_spine(sp)
+        assert not bool(ovf)
+        got: dict = {}
+        for r in sp.base.to_rows():
+            got[r[:-2]] = got.get(r[:-2], 0) + r[-1]
+        assert {k: d for k, d in got.items() if d} == {
+            k: d for k, d in ms.items() if d
+        }
+
+
+def test_run_span_matches_run_steps():
+    """The one-dispatch span program (lax.scan chunks + traced
+    compactions) must produce exactly the per-step path's results —
+    same output arrangement, same deltas."""
+    from materialize_tpu.expr import relation as mir
+    from materialize_tpu.render.dataflow import Dataflow
+
+    rng = np.random.default_rng(11)
+    spans = []
+    for t in range(16):
+        n = 120
+        ks = rng.integers(0, 300, n)
+        vs = rng.integers(0, 3, n)
+        ds = rng.integers(-1, 2, n)
+        ds[ds == 0] = 1
+        spans.append({"L": _batch(ks, vs, ds, t=t, cap=256)})
+
+    df_a = Dataflow(mir.Get("L", SCH), state_cap=256)
+    df_a._compact_every = 4
+    df_a.run_steps(spans, defer_check=True)
+    df_a.check_flags()
+    a = sorted(df_a.peek())
+
+    df_b = Dataflow(mir.Get("L", SCH), state_cap=256)
+    df_b._compact_every = 4
+    deltas = df_b.run_span(spans)
+    assert deltas is not None
+    df_b.check_flags()
+    b = sorted(df_b.peek())
+    # Times may differ in compaction leaders? No: content-identical.
+    assert [r[:-2] + (r[-1],) for r in a] == [
+        r[:-2] + (r[-1],) for r in b
+    ]
+    assert df_b.time == df_a.time

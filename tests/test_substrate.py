@@ -145,8 +145,13 @@ class TestSearch:
 
 class TestMerge:
     def test_merge_sorted_matches_full_sort(self):
-        a = consolidate(random_batch(100, seed=1))
-        b = consolidate(random_batch(80, seed=2))
+        # merge_sorted requires inputs sorted by the lanes passed;
+        # consolidate() emits HASH order (round-5 redesign), so sort
+        # the inputs into exact key order first.
+        from materialize_tpu.arrangement.spine import arrange
+
+        a = arrange(random_batch(100, seed=1), (0, 1)).batch
+        b = arrange(random_batch(80, seed=2), (0, 1)).batch
         a_lanes = key_lanes(a, [0, 1])
         b_lanes = key_lanes(b, [0, 1])
         out_cap = capacity_tier(a.capacity + b.capacity)
