@@ -189,33 +189,46 @@ def lookup_range(arr: Arrangement, probe_lanes) -> tuple:
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class Spine:
-    """Amortized two-run arrangement: ``base`` (large, consolidated) plus
-    ``tail`` (small, absorbs per-step deltas). Logical content is the
-    multiset sum of both runs; each run is individually sorted by the
-    order mode's lanes and consolidated, but the SAME row may appear in
-    both runs — readers must combine (probe both runs; sum diffs
-    downstream).
+    """Amortized MULTI-RUN arrangement: a geometric ladder of
+    consolidated sorted runs, smallest first (``runs_b[0]`` absorbs
+    per-step deltas; ``runs_b[-1]`` is the base). Logical content is
+    the multiset sum of all runs; each run is individually sorted by
+    the order mode's lanes and consolidated, but the SAME row may
+    appear in several runs — readers combine (probe every run; sum
+    diffs downstream).
 
-    The point: per-step insert cost is O(tail capacity), independent of
-    state size, so a 2^20-row arrangement can absorb 4k-row deltas
-    without a full-state pass per step. The O(base) merge happens in a
-    separate host-scheduled ``compact_spine`` dispatch every K steps —
-    amortized cost O(base * delta / tail) per step, the differential
-    spine's geometric-merge budget re-cast for fixed XLA shapes.
+    The point (differential's geometric spine merges, re-cast for
+    fixed XLA shapes): per-step insert cost is O(runs_b[0] capacity);
+    level l is folded into level l+1 every ``ratio^l`` compaction
+    ticks, so a row is merged O(levels) times over its lifetime and
+    the per-step amortized merge cost is O(levels * delta) — NOT
+    O(state). Two levels reproduce the round-3/4 base+tail form; the
+    big output index runs 3-4 levels.
     """
 
-    base: Batch
-    tail: Batch
+    runs_b: tuple  # Batches, smallest-first
     key: tuple  # static: key column indices
     order: str = "exact"  # static: "exact" | "hash"
 
     def tree_flatten(self):
-        return (self.base, self.tail), (self.key, self.order)
+        return (self.runs_b,), (self.key, self.order)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         key, order = aux
-        return cls(children[0], children[1], key, order)
+        return cls(children[0], key, order)
+
+    @property
+    def levels(self) -> int:
+        return len(self.runs_b)
+
+    @property
+    def base(self) -> Batch:
+        return self.runs_b[-1]
+
+    @property
+    def tail(self) -> Batch:
+        return self.runs_b[0]
 
     @property
     def schema(self) -> Schema:
@@ -230,15 +243,23 @@ class Spine:
     def tail_capacity(self) -> int:
         return self.tail.capacity
 
-    def runs(self) -> tuple[Arrangement, Arrangement]:
-        """Single-run views for lookup/probe code (base first)."""
-        return (
-            Arrangement(self.base, self.key, self.order),
-            Arrangement(self.tail, self.key, self.order),
+    def with_run(self, i: int, batch: Batch) -> "Spine":
+        rs = list(self.runs_b)
+        rs[i] = batch
+        return Spine(tuple(rs), self.key, self.order)
+
+    def runs(self) -> tuple:
+        """Single-run Arrangement views for lookup/probe code (base
+        first, then progressively smaller runs)."""
+        return tuple(
+            Arrangement(b, self.key, self.order)
+            for b in reversed(self.runs_b)
         )
 
     def map_batches(self, fn) -> "Spine":
-        return Spine(fn(self.base), fn(self.tail), self.key, self.order)
+        return Spine(
+            tuple(fn(b) for b in self.runs_b), self.key, self.order
+        )
 
     @staticmethod
     def empty(
@@ -247,53 +268,75 @@ class Spine:
         capacity: int = 256,
         tail_capacity: int = 1024,
         order: str = "exact",
+        levels: int = 2,
+        ratio: int = 8,
     ) -> "Spine":
+        """Capacities run geometrically from tail_capacity up, with the
+        base pinned at ``capacity``."""
+        assert levels >= 2
+        caps = [tail_capacity * (ratio**i) for i in range(levels - 1)]
+        caps.append(capacity)  # base pinned exactly (callers may size
+        # it below the mids deliberately to provoke overflow growth)
         return Spine(
-            Batch.empty(schema, capacity),
-            Batch.empty(schema, tail_capacity),
+            tuple(Batch.empty(schema, c) for c in caps),
             tuple(key),
             order,
         )
 
 
 def insert_tail(spine: Spine, delta: Batch) -> tuple[Spine, jnp.ndarray]:
-    """Merge a delta batch into the spine's tail run only — the hot-path
-    insert. O(tail capacity); the base run is untouched (no copy: it
-    passes through the step as the same buffer).
+    """Merge a delta batch into the spine's smallest run only — the
+    hot-path insert. O(runs_b[0] capacity); every other run passes
+    through untouched (no copy: same buffers).
 
     Returns (new_spine, tail_overflowed). On overflow the host grows the
     tail tier (or compacts more often) and replays."""
     d = arrange(delta, spine.key, capacity=None, order=spine.order)
-    tail_arr = Arrangement(spine.tail, spine.key, spine.order)
+    tail = spine.tail
+    tail_arr = Arrangement(tail, spine.key, spine.order)
     merged, overflow = merge_sorted(
-        spine.tail,
+        tail,
         tail_arr.sort_lanes(),
         d.batch,
         d.sort_lanes(),
-        spine.tail.capacity,
+        tail.capacity,
     )
     cons = consolidate_sorted(merged)
-    return Spine(spine.base, cons, spine.key, spine.order), overflow
+    return spine.with_run(0, cons), overflow
 
 
-def compact_spine(spine: Spine) -> tuple[Spine, jnp.ndarray]:
-    """Merge the tail into the base: the amortized O(base) spine merge,
-    dispatched by the host every K steps (and before peeks/snapshots).
-    Sort-free: both runs share the spine's order, so the merge is a
-    binary-search + one row-gather per dtype family, and duplicate
-    summation is the exact adjacent comparison (no sort at state
-    capacity — XLA's TPU sort compile is superlinear in rows and
-    operands, PERF_NOTES.md).
-
-    Returns (new_spine with empty tail, base_overflowed)."""
-    base_arr, tail_arr = spine.runs()
+def compact_level(spine: Spine, level: int) -> tuple[Spine, jnp.ndarray]:
+    """Fold run ``level`` into run ``level+1`` (the geometric ladder
+    step). Sort-free: runs share the spine's order, so the merge is a
+    binary search + one row-gather per dtype family, and duplicate
+    summation is the exact adjacent comparison. Returns (new_spine,
+    overflowed) where the flag is level+1's capacity overflow."""
+    lo, hi = spine.runs_b[level], spine.runs_b[level + 1]
+    lo_arr = Arrangement(lo, spine.key, spine.order)
+    hi_arr = Arrangement(hi, spine.key, spine.order)
     merged, overflow = merge_sorted(
-        spine.base,
-        base_arr.sort_lanes(),
-        spine.tail,
-        tail_arr.sort_lanes(),
-        spine.base.capacity,
+        hi,
+        hi_arr.sort_lanes(),
+        lo,
+        lo_arr.sort_lanes(),
+        hi.capacity,
     )
     cons = consolidate_sorted(merged)
-    empty_tail = spine.tail.replace(count=jnp.zeros_like(spine.tail.count))
-    return Spine(cons, empty_tail, spine.key, spine.order), overflow
+    out = spine.with_run(level + 1, cons)
+    out = out.with_run(
+        level, lo.replace(count=jnp.zeros_like(lo.count))
+    )
+    return out, overflow
+
+
+def compact_spine(spine: Spine):
+    """Full cascade: fold every run into the base (peeks and snapshots
+    read the base as THE consolidated state). Cascades bottom-up
+    (run0 -> run1, then run1 -> run2, ...) so the base absorbs
+    everything in levels-1 merges. Returns (new_spine, overflow flags
+    [levels-1], one per target run, smallest target first)."""
+    flags = []
+    for level in range(spine.levels - 1):
+        spine, ovf = compact_level(spine, level)
+        flags.append(ovf)
+    return spine, jnp.stack(flags)

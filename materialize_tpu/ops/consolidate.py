@@ -29,6 +29,13 @@ def consolidate(batch: Batch, include_time: bool = True) -> Batch:
     order — any total order on row content works for consolidation,
     and hash-ordered arrangements share it so their merges stay
     sort-free)."""
+    if "hash_consolidated" in batch.hints:
+        # Producer guarantee (e.g. host-presorted load-generator
+        # batches): already sorted by the hash order, unique by
+        # content, nonzero diffs — consolidation is the identity, and
+        # skipping it removes the input-side device sort (the large-
+        # micro-batch cost ceiling; PERF_NOTES.md).
+        return batch
     cap = batch.capacity
     h1, h2 = hash_pair(row_lanes(batch, include_time=False))
     ops = [h1, h2]

@@ -40,6 +40,7 @@ from ..arrangement.spine import (
     Arrangement,
     Spine,
     arrange,
+    compact_level,
     compact_spine,
     insert,
     insert_tail,
@@ -59,6 +60,20 @@ from ..parallel.exchange import exchange
 from ..parallel.mesh import WORKER_AXIS, worker_sharding
 from ..repr.batch import Batch, capacity_tier
 from ..repr.schema import DIFF_DTYPE, TIME_DTYPE, Schema
+
+
+# The span program nests cumulative scans (reduce-window lowerings)
+# inside lax.scan; at big run capacities the default 16MiB scoped-vmem
+# budget overflows at compile time ("Ran out of memory in memory space
+# vmem ... scoped"). Raise it for span compiles only (v5e has 128MiB
+# VMEM; 64MiB scoped leaves ample room). TPU-only option — CPU/other
+# backends reject it.
+
+
+def _span_compiler_options():
+    if jax.default_backend() in ("tpu", "axon"):
+        return {"xla_tpu_scoped_vmem_limit_kib": 65536}
+    return None
 
 
 @dataclass
@@ -876,7 +891,7 @@ class _DataflowBase:
     pipelined run (device->host transfers through the TPU tunnel are the
     latency cost center, so the hot loop never reads data back)."""
 
-    def _init_output(self, capacity: int = 256):
+    def _init_output(self, capacity: int = 256, levels: int = 2):
         from ..repr.schema import ERR_SCHEMA
 
         out_key = tuple(range(self.out_schema.arity))
@@ -891,6 +906,7 @@ class _DataflowBase:
             self.out_schema, out_key, capacity,
             tail_capacity=self._ctx.out_delta_cap,
             order="hash",
+            levels=levels,
         )
         # The err collection: scalar-evaluation errors maintained next
         # to the data output (ok/err pair, render.rs:12-101). Reads
@@ -915,27 +931,49 @@ class _DataflowBase:
         self._defer_log: list = []
         self._defer_flags = None
         self._defer_cflags = None
-        # Spine-compaction schedule: every K steps the host dispatches
-        # one compact program that merges every spine's tail into its
-        # base (the amortized O(state) merge; differential's spine-merge
-        # exert budget). Deterministic — driven by a host counter that
-        # is part of the rollback checkpoint, so overflow replays
-        # reproduce the same schedule.
+        # Spine-compaction schedule (differential's geometric spine-
+        # merge budget): every `_compact_every` steps, fold level 0 of
+        # every spine into level 1; every `_compact_every *
+        # _compact_ratio^l` steps, also fold level l. Deterministic —
+        # driven by a host tick counter that is part of the rollback
+        # checkpoint, so overflow replays reproduce the same schedule.
         self._compact_every = 8
-        self._steps_since_compact = 0
-        self._compact_jit = None
+        self._compact_ratio = 8
+        self._compact_tick = 0
+        self._compact_jits: dict = {}
         self._covf_keys = self._compact_keys()
 
+    # Back-compat shim for callers that poked the old counter directly.
+    @property
+    def _steps_since_compact(self) -> int:
+        return self._compact_tick % self._compact_every
+
     def _compact_keys(self) -> list:
-        """Overflow-flag keys of the compact program (base-run growth),
-        in the deterministic order the program packs them."""
+        """Overflow-flag keys of the compact program (per-target-run
+        growth across every spine level), in the deterministic order
+        every compact variant packs them (variants that do not touch a
+        level pack False for it — flag shape is uniform)."""
         keys = []
         for slot, parts in enumerate(self.states):
             for p, s in enumerate(parts):
                 if isinstance(s, Spine):
-                    keys.append(("state", slot, (p, "base")))
-        keys.append(("out", "base"))
+                    for ri in range(1, s.levels):
+                        keys.append(("state", slot, (p, ri)))
+        for ri in range(1, self.output.levels):
+            keys.append(("out", ri))
         return keys
+
+    def _due_levels(self, tick: int) -> int:
+        """Highest spine level due for folding at compaction tick
+        `tick` (tick counts steps; called when tick %
+        _compact_every == 0). Level l folds every
+        _compact_every * _compact_ratio^l steps."""
+        lvl = 0
+        period = self._compact_every * self._compact_ratio
+        while tick % period == 0:
+            lvl += 1
+            period *= self._compact_ratio
+        return lvl
 
     def _pack_flags(self, ovf: dict) -> jnp.ndarray:
         """Deterministically order overflow flags into one tiny array.
@@ -991,17 +1029,16 @@ class _DataflowBase:
         return arr.map_batches(lambda b: self._grow_batch(b, target))
 
     def _grow_spine(
-        self, spine: Spine, which: str, target: int | None = None
+        self, spine: Spine, which, target: int | None = None
     ) -> Spine:
+        """Grow one run of a spine. `which` is a run index, or the
+        legacy aliases "base" (largest run) / "tail" (run 0)."""
         if which == "base":
-            return Spine(
-                self._grow_batch(spine.base, target), spine.tail,
-                spine.key, spine.order,
-            )
-        assert which == "tail", which
-        return Spine(
-            spine.base, self._grow_batch(spine.tail, target),
-            spine.key, spine.order,
+            which = spine.levels - 1
+        elif which == "tail":
+            which = 0
+        return spine.with_run(
+            which, self._grow_batch(spine.runs_b[which], target)
         )
 
     def step(self, inputs: dict) -> Batch:
@@ -1077,7 +1114,7 @@ class _DataflowBase:
             self.err_output,
             self.time,
             self._time_dev,
-            self._steps_since_compact,
+            self._compact_tick,
         )
 
     def _restore(self, ck):
@@ -1087,39 +1124,51 @@ class _DataflowBase:
             self.err_output,
             self.time,
             self._time_dev,
-            self._steps_since_compact,
+            self._compact_tick,
         ) = ck
 
-    def _dispatch_compact(self):
-        """Dispatch one spine-compaction program (merge every spine's
-        tail into its base). Async like steps; returns its packed
-        base-overflow flags (key order: self._covf_keys)."""
-        if self._compact_jit is None:
-            self._compact_jit = self._make_compact_jit()
-        new_states, new_output, cfl = self._compact_jit(
+    def _dispatch_compact(self, max_level: int = 10**9):
+        """Dispatch one spine-compaction program folding levels
+        [0, max_level] of every spine (clamped per spine; the default
+        is a full cascade). Async like steps; returns its packed
+        per-target-run overflow flags (key order: self._covf_keys —
+        uniform across variants; untouched levels pack False)."""
+        jitfn = self._compact_jits.get(max_level)
+        if jitfn is None:
+            jitfn = self._make_compact_jit(max_level)
+            self._compact_jits[max_level] = jitfn
+        new_states, new_output, cfl = jitfn(
             tuple(self.states), self.output
         )
         self.states = list(new_states)
         self.output = new_output
         return cfl
 
-    def _compact_core_single(self, states, output):
+    def _compact_core_single(self, states, output, max_level: int = 10**9):
         """Trace body of the compact program (single-device layout).
-        Walks the static state layout; only Spine parts are touched."""
+        Walks the static state layout; only Spine parts are touched —
+        levels [0, max_level] of each (clamped to the spine's depth)."""
         flags = {}
         new_states = []
         for slot, parts in enumerate(states):
             ps = list(parts)
             for p, s in enumerate(ps):
                 if isinstance(s, Spine):
-                    ps[p], ovf = compact_spine(s)
-                    flags[("state", slot, (p, "base"))] = ovf
+                    sp = s
+                    for lvl in range(min(max_level + 1, sp.levels - 1)):
+                        sp, ovf = compact_level(sp, lvl)
+                        flags[("state", slot, (p, lvl + 1))] = ovf
+                    ps[p] = sp
             new_states.append(tuple(ps))
-        new_out, oovf = compact_spine(output)
-        flags[("out", "base")] = oovf
+        new_out = output
+        for lvl in range(min(max_level + 1, output.levels - 1)):
+            new_out, ovf = compact_level(new_out, lvl)
+            flags[("out", lvl + 1)] = ovf
         packed = jnp.stack(
             [
-                jnp.asarray(flags[k]).astype(jnp.bool_).reshape(())
+                jnp.asarray(
+                    flags.get(k, jnp.asarray(False))
+                ).astype(jnp.bool_).reshape(())
                 for k in self._covf_keys
             ]
         )
@@ -1164,12 +1213,17 @@ class _DataflowBase:
             self._time += 1  # direct: keep the device carry live
             deltas.append(out)
             flags_or = self._or_acc(flags_or, fl)
-            self._steps_since_compact += 1
-            if self._steps_since_compact >= self._compact_every:
+            self._compact_tick += 1
+            if self._compact_tick % self._compact_every == 0:
                 cflags_or = self._or_acc(
-                    cflags_or, self._dispatch_compact()
+                    cflags_or,
+                    self._dispatch_compact(
+                        min(
+                            self._due_levels(self._compact_tick),
+                            self._max_compact_level(),
+                        )
+                    ),
                 )
-                self._steps_since_compact = 0
         return deltas, flags_or, cflags_or
 
     def _read_flags(self, flags_or, keys: list) -> np.ndarray:
@@ -1197,13 +1251,13 @@ class _DataflowBase:
         return out
 
     def _compact_now(self) -> None:
-        """Synchronously compact every spine (tail -> base): peeks and
-        snapshots read the base run as THE consolidated state. Grows
-        base tiers on overflow and retries."""
+        """Synchronously compact every spine (full cascade into the
+        base): peeks and snapshots read the base run as THE
+        consolidated state. Grows run tiers on overflow and retries."""
         while True:
             ck = self._checkpoint()
             cfl = self._dispatch_compact()
-            self._steps_since_compact = 0
+            self._compact_tick = 0
             over = self._read_flags(cfl, self._covf_keys)
             if not over.any():
                 return
@@ -1221,12 +1275,13 @@ class _DataflowBase:
         return self.output.base
 
     def output_records(self) -> int:
-        """Approximate maintained row count (base + tail counts; may
+        """Approximate maintained row count (sum over all runs; may
         overcount rows whose diffs cancel across runs until the next
         compaction). Introspection only — one small d2h read."""
         return int(
-            np.asarray(self.output.base.count).sum()
-            + np.asarray(self.output.tail.count).sum()
+            sum(
+                np.asarray(b.count).sum() for b in self.output.runs_b
+            )
         )
 
     def run_steps(self, inputs_list: list, defer_check: bool = False) -> list:
@@ -1310,49 +1365,73 @@ class _DataflowBase:
             out[name] = jax.tree_util.tree_unflatten(treedef, stacked)
         return out
 
-    def _make_span_jit(self, n_chunks: int, with_env: bool):
-        ce = self._compact_every
+    def _max_compact_level(self) -> int:
+        """Deepest fold index any spine in this dataflow can take."""
+        deepest = self.output.levels - 2
+        for parts in self.states:
+            for s in parts:
+                if isinstance(s, Spine):
+                    deepest = max(deepest, s.levels - 2)
+        return deepest
 
-        def span(states, output, err_output, time_dev, stacked, *env_a):
+    def _make_span_jit(self, with_env: bool):
+        """ONE program for every span shape: an outer lax.scan over
+        chunks whose xs carry (chunk inputs, compaction level) — the
+        geometric cadence is RUNTIME DATA dispatched with lax.switch,
+        so the pattern never forces a recompile (the unrolled-chunk
+        form compiled one ~3-minute variant per distinct pattern)."""
+        ce = self._compact_every
+        n_branches = self._max_compact_level() + 1
+
+        def span(states, output, err_output, time_dev, chunks, levels,
+                 *env_a):
             env = env_a[0] if env_a else None
 
-            def body(carry, xs):
+            def chunk_body(carry, xs):
+                chunk, lvl = xs
                 st, o, e, t = carry
-                if env is not None:
-                    out, ns, no, ne, nt, fl = self._step_core(
-                        st, o, e, xs, t, env
-                    )
-                else:
-                    out, ns, no, ne, nt, fl = self._step_core(
-                        st, o, e, xs, t
-                    )
-                return (ns, no, ne, nt), (out, fl)
+                # Only the spine's INGEST run rides the inner scan
+                # carry; upper runs are chunk-invariant (the step never
+                # touches them) and rejoin only for the compaction.
+                upper = o.runs_b[1:]
+
+                def step_body(c2, x):
+                    st2, run0, e2, t2 = c2
+                    o2 = Spine((run0,) + upper, o.key, o.order)
+                    if env is not None:
+                        out, ns, no, ne, nt, fl = self._step_core(
+                            st2, o2, e2, x, t2, env
+                        )
+                    else:
+                        out, ns, no, ne, nt, fl = self._step_core(
+                            st2, o2, e2, x, t2
+                        )
+                    return (ns, no.runs_b[0], ne, nt), (out, fl)
+
+                (st, run0, e, t), (deltas, fls) = jax.lax.scan(
+                    step_body, (st, o.runs_b[0], e, t), chunk
+                )
+                o = Spine((run0,) + upper, o.key, o.order)
+                branches = [
+                    (lambda s_, o_, m=m: self._compact_core_single(
+                        s_, o_, m
+                    ))
+                    for m in range(n_branches)
+                ]
+                st, o, cfl = jax.lax.switch(lvl, branches, st, o)
+                return (st, o, e, t), (deltas, fls.any(axis=0), cfl)
 
             carry = (tuple(states), output, err_output, time_dev)
-            sfl_or, cfl_or = None, None
-            delta_chunks = []
-            rest = stacked
-            for _ in range(n_chunks):
-                chunk = jax.tree_util.tree_map(lambda a: a[:ce], rest)
-                rest = jax.tree_util.tree_map(lambda a: a[ce:], rest)
-                carry, (deltas, fls) = jax.lax.scan(body, carry, chunk)
-                delta_chunks.append(deltas)
-                sfl = fls.any(axis=0)
-                sfl_or = sfl if sfl_or is None else jnp.logical_or(
-                    sfl_or, sfl
-                )
-                st, o, e, t = carry
-                ns2, no2, cfl = self._compact_core_single(st, o)
-                cfl_or = cfl if cfl_or is None else jnp.logical_or(
-                    cfl_or, cfl
-                )
-                carry = (tuple(ns2), no2, e, t)
+            carry, (deltas, sfls, cfls) = jax.lax.scan(
+                chunk_body, carry, (chunks, levels)
+            )
+            # deltas leaves: [n_chunks, ce, ...] -> [K, ...]
             deltas_all = jax.tree_util.tree_map(
-                lambda *xs: jnp.concatenate(xs), *delta_chunks
-            ) if len(delta_chunks) > 1 else delta_chunks[0]
-            return carry, deltas_all, sfl_or, cfl_or
+                lambda a: a.reshape((-1,) + a.shape[2:]), deltas
+            )
+            return carry, deltas_all, sfls.any(axis=0), cfls.any(axis=0)
 
-        return jax.jit(span)
+        return jax.jit(span, compiler_options=_span_compiler_options())
 
     def run_span(self, inputs_list: list):
         """Feed a span of micro-batches as ONE device dispatch (deferred
@@ -1375,28 +1454,41 @@ class _DataflowBase:
         # time must be able to roll all of it back.
         if self._defer_ck is None:
             self._defer_ck = self._checkpoint()
-        if self._steps_since_compact:
-            # Flush so the span's internal compaction schedule starts
-            # from a clean counter (deterministic with per-step paths).
+        if self._compact_tick % ce:
+            # Flush (full cascade) so the span's internal compaction
+            # schedule starts from a clean counter.
             cfl = self._dispatch_compact()
             self._defer_cflags = self._or_acc(self._defer_cflags, cfl)
-            self._steps_since_compact = 0
+            self._compact_tick = 0
         packed = [self._pack_inputs(i) for i in inputs_list]
         env = self._build_env()
         if self._time_dev is None:
             self._time_dev = jnp.asarray(self.time, dtype=jnp.uint64)
         n_chunks = len(inputs_list) // ce
+        levels = jnp.asarray(
+            [
+                min(
+                    self._due_levels(self._compact_tick + (j + 1) * ce),
+                    self._max_compact_level(),
+                )
+                for j in range(n_chunks)
+            ],
+            dtype=jnp.int32,
+        )
         if not hasattr(self, "_span_jits"):
             self._span_jits = {}
         key = (ce, n_chunks, env is not None)
         jitfn = self._span_jits.get(key)
         if jitfn is None:
-            jitfn = self._make_span_jit(n_chunks, env is not None)
+            jitfn = self._make_span_jit(env is not None)
             self._span_jits[key] = jitfn
         stacked = self._stack_packed(packed)
+        chunks = jax.tree_util.tree_map(
+            lambda a: a.reshape((n_chunks, ce) + a.shape[1:]), stacked
+        )
         args = (
             tuple(self.states), self.output, self.err_output,
-            self._time_dev, stacked,
+            self._time_dev, chunks, levels,
         )
         if env is not None:
             carry, deltas, sfl, cfl = jitfn(*args, env)
@@ -1408,6 +1500,7 @@ class _DataflowBase:
         self.err_output = e
         self._time_dev = t
         self._time += len(inputs_list)
+        self._compact_tick += len(inputs_list)
         # Rollback/replay bookkeeping: replays reuse the ordinary
         # per-step path (compaction timing differs, which is
         # semantically transparent — compaction never changes content).
@@ -1467,7 +1560,7 @@ class Dataflow(_DataflowBase):
     """
 
     def __init__(self, expr: mir.RelationExpr, name: str = "df",
-                 state_cap: int = 256):
+                 state_cap: int = 256, out_levels: int = 2):
         from ..expr import strings
 
         self.expr = expr
@@ -1479,7 +1572,10 @@ class Dataflow(_DataflowBase):
         self._ctx = ctx
         self._basic_finalizers = _resolve_basic_sites(expr, ctx)
         self.states = [s.init for s in ctx.slots]
-        self._init_output()
+        # Big output indexes run a deeper geometric run ladder
+        # (out_levels=3-4) so base-scale merges amortize to every
+        # ratio^(levels-1) steps (spine.py).
+        self._init_output(levels=out_levels)
         self.time = 0  # frontier: all steps < time are complete
         self._remake_jit()
 
@@ -1506,8 +1602,10 @@ class Dataflow(_DataflowBase):
         cap = target if target is not None else b.capacity * 2
         return b.with_capacity(cap) if cap > b.capacity else b
 
-    def _make_compact_jit(self):
-        return jax.jit(self._compact_core_single)
+    def _make_compact_jit(self, max_level: int = 10**9):
+        return jax.jit(
+            lambda s, o: self._compact_core_single(s, o, max_level)
+        )
 
     def _pack_inputs(self, inputs: dict) -> dict:
         return inputs
@@ -1970,7 +2068,7 @@ class ShardedDataflow(_DataflowBase):
             "one dispatch per step)"
         )
 
-    def _make_compact_jit(self):
+    def _make_compact_jit(self, max_level: int = 10**9):
         axis = self.axis_name
         scalar_counts = self._scalar_counts
         vec_counts = self._vec_counts
@@ -1979,7 +2077,7 @@ class ShardedDataflow(_DataflowBase):
             states = [scalar_counts(s) for s in states]
             (output,) = scalar_counts((output,))
             new_states, new_out, fl = self._compact_core_single(
-                states, output
+                states, output, max_level
             )
             new_states = tuple(vec_counts(s) for s in new_states)
             (new_out,) = vec_counts((new_out,))

@@ -77,7 +77,7 @@ base_rows = 1 << 21
 tail_cap = 32768
 big = Batch.empty(LINEITEM_SCHEMA, base_rows)
 tail = Batch.empty(LINEITEM_SCHEMA, tail_cap)
-sp = Spine(big, tail, key)
+sp = Spine((tail, big), key, "exact")
 
 rpt("insert_tail (4096 -> 32768)", timed(
     jax.jit(lambda s, d: insert_tail(s, d)[0].tail), sp, b4k))

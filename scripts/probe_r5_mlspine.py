@@ -1,0 +1,120 @@
+"""Honest TPU timing of the 4-level output spine + span-scan execution
+on the index config shape: hydrate sf=0.25 lineitem via run_span, then
+measure churn spans."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+t0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter() - t0:8.1f}s] {msg}", flush=True)
+
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from materialize_tpu.expr import relation as mir
+from materialize_tpu.render.dataflow import Dataflow
+from materialize_tpu.storage.generator.tpch import (
+    LINEITEM_SCHEMA,
+    TpchGenerator,
+)
+
+ORDERS_PER_TICK = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+
+
+def tier(n):
+    c = 256
+    while c < n:
+        c *= 2
+    return c
+
+
+ROWS_PER_TICK = int(ORDERS_PER_TICK * 4.5 * 2)  # delete+insert, ~4/order
+CAP = tier(ROWS_PER_TICK)
+CE, RATIO = 4, 4
+
+gen = TpchGenerator(sf=0.25, seed=42)
+df = Dataflow(mir.Get("lineitem", LINEITEM_SCHEMA), out_levels=4)
+df._compact_every = CE
+df._compact_ratio = RATIO
+# Run ladder: run_i must hold CE * RATIO^i ticks between folds
+# (clamped at the base tier — a mid bigger than the base is pointless).
+BASE = 1 << 21
+for ri in range(3):
+    df._grow_for(
+        ("out", ri),
+        target=min(tier(2 * CE * RATIO**ri * ROWS_PER_TICK), BASE),
+    )
+df._grow_for(("out", 3), target=BASE)
+df._ctx.out_delta_cap = CAP
+df._remake_jit()
+np.asarray(jnp.zeros((1,)) + 1)  # honest mode
+log(f"built (orders/tick={ORDERS_PER_TICK}, cap={CAP}, "
+    f"runs={[b.capacity for b in df.output.runs_b]}); hydrating")
+
+# Hydration batches as large as run0 absorbs (presorted ingest: no
+# device sort at any batch size) — the snapshot loads in O(10) steps.
+run0_cap = df.output.runs_b[0].capacity
+# run0 absorbs CE hydration ticks between folds; ~4.5 rows/order.
+h_orders = max(896, run0_cap // (CE * 9))
+t = time.perf_counter()
+hydrate = list(
+    gen.snapshot_lineitem_batches(batch_orders=h_orders, time=0)
+)
+log(f"generated {len(hydrate)} hydration batches "
+    f"({h_orders} orders each) in {time.perf_counter() - t:.1f}s")
+K = 32
+t = time.perf_counter()
+n_h = len(hydrate) - len(hydrate) % K
+for i in range(0, n_h, K):
+    df.run_span([{"lineitem": b} for b in hydrate[i : i + K]])
+rest = hydrate[n_h:]
+if rest:
+    df.run_steps([{"lineitem": b} for b in rest], defer_check=True)
+jax.block_until_ready(df.output.base.diff)
+log(f"hydrate {len(hydrate)} steps in {time.perf_counter() - t:.1f}s")
+t = time.perf_counter()
+ovf = df.check_flags()
+log(f"check_flags {time.perf_counter() - t:.1f}s (ovf={ovf})")
+
+t = time.perf_counter()
+ticks = []
+counts = []
+for i in range(3 * K):
+    b = gen.churn_lineitem_batch(
+        ORDERS_PER_TICK, tick=i, time=df.time + i, capacity=CAP
+    )
+    ticks.append({"lineitem": b})
+    counts.append(b._host_count)
+log(f"generate {3*K} ticks in {time.perf_counter() - t:.1f}s "
+    f"({sum(counts)} rows)")
+
+# warmup span (compiles)
+t = time.perf_counter()
+df.run_span(ticks[:K])
+jax.block_until_ready(df.output.tail.diff)
+log(f"warmup span (compile+run) {time.perf_counter() - t:.1f}s")
+
+for s in range(1, 3):
+    chunk = ticks[s * K : (s + 1) * K]
+    n_upd = sum(counts[s * K : (s + 1) * K])
+    t = time.perf_counter()
+    d = df.run_span(chunk)
+    jax.block_until_ready(jax.tree_util.tree_leaves(d)[0])
+    dt = time.perf_counter() - t
+    log(f"span {s}: {dt*1000:.0f}ms -> {dt/K*1000:.1f} ms/step, "
+        f"{n_upd/dt/1e6:.2f}M updates/s")
+t = time.perf_counter()
+ovf = df.check_flags()
+log(f"final check_flags {time.perf_counter() - t:.1f}s (ovf={ovf})")
+rows = int(np.asarray(df.output.base.count).sum())
+log(f"base rows pre-cascade: {rows}")
+df._compact_now()
+rows = int(np.asarray(df.output.base.count).sum())
+log(f"state_rows={rows}")

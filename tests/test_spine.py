@@ -316,3 +316,86 @@ def test_run_span_matches_run_steps():
         r[:-2] + (r[-1],) for r in b
     ]
     assert df_b.time == df_a.time
+
+
+def test_multilevel_output_spine_oracle():
+    """4-level geometric output spine under churn with retractions and
+    growth: peeks (full cascade) stay oracle-exact, and the in-span
+    geometric cadence (run_span) matches the per-step path."""
+    from materialize_tpu.expr import relation as mir
+    from materialize_tpu.render.dataflow import Dataflow
+
+    rng = np.random.default_rng(23)
+    spans = []
+    oracle: dict = {}
+    for t in range(32):
+        n = 100
+        ks = rng.integers(0, 800, n)
+        vs = rng.integers(0, 3, n)
+        ds = rng.integers(-1, 2, n)
+        ds[ds == 0] = 1
+        for k, v, d in zip(ks, vs, ds):
+            key = (int(k), int(v))
+            oracle[key] = oracle.get(key, 0) + int(d)
+        spans.append({"L": _batch(ks, vs, ds, t=t, cap=256)})
+    oracle = {k: d for k, d in oracle.items() if d}
+
+    df = Dataflow(mir.Get("L", SCH), state_cap=256, out_levels=4)
+    df._compact_every = 4
+    df._compact_ratio = 2
+    assert df.output.levels == 4
+    df.run_steps(spans, defer_check=True)
+    df.check_flags()
+    got: dict = {}
+    for r in df.peek():
+        got[r[:-2]] = got.get(r[:-2], 0) + r[-1]
+    assert {k: d for k, d in got.items() if d} == oracle
+
+    df2 = Dataflow(mir.Get("L", SCH), state_cap=256, out_levels=4)
+    df2._compact_every = 4
+    df2._compact_ratio = 2
+    df2.run_span(spans)
+    df2.check_flags()
+    got2: dict = {}
+    for r in df2.peek():
+        got2[r[:-2]] = got2.get(r[:-2], 0) + r[-1]
+    assert {k: d for k, d in got2.items() if d} == oracle
+
+
+def test_host_presort_matches_device_order():
+    """Generator batches carrying the "hash_consolidated" hint must be
+    in EXACTLY the device hash order (numpy replica of hash_pair), and
+    a dataflow fed hinted batches must match one fed the same batches
+    with the hint stripped (which re-sorts on device)."""
+    import numpy as np
+
+    from materialize_tpu.expr import relation as mir
+    from materialize_tpu.ops.lanes import hash_pair, row_lanes
+    from materialize_tpu.render.dataflow import Dataflow
+    from materialize_tpu.storage.generator.tpch import (
+        LINEITEM_SCHEMA,
+        TpchGenerator,
+    )
+
+    gen = TpchGenerator(sf=0.002, seed=5)
+    batches = list(gen.snapshot_lineitem_batches(batch_orders=512))
+    for t in range(6):
+        batches.append(
+            gen.churn_lineitem_batch(64, tick=t, time=1 + t)
+        )
+    for b in batches:
+        assert b.hints == ("hash_consolidated",)
+        n = b._host_count
+        h1, h2 = hash_pair(row_lanes(b, include_time=False))
+        h1, h2 = np.asarray(h1)[:n], np.asarray(h2)[:n]
+        pairs = list(zip(h1.tolist(), h2.tolist()))
+        assert pairs == sorted(pairs), "host order != device hash order"
+
+    df_hint = Dataflow(mir.Get("lineitem", LINEITEM_SCHEMA))
+    df_plain = Dataflow(mir.Get("lineitem", LINEITEM_SCHEMA))
+    for i, b in enumerate(batches):
+        df_hint.step({"lineitem": b})
+        df_plain.step({"lineitem": b.replace(hints=())})
+    assert sorted(
+        r[:-2] + (r[-1],) for r in df_hint.peek()
+    ) == sorted(r[:-2] + (r[-1],) for r in df_plain.peek())
