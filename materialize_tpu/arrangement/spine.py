@@ -209,13 +209,27 @@ class Spine:
     runs_b: tuple  # Batches, smallest-first
     key: tuple  # static: key column indices
     order: str = "exact"  # static: "exact" | "hash"
+    # Optional APPEND-SLOT ingest ring (round-5 perf design): S
+    # independently sorted slot batches below runs_b[0]. With slots,
+    # insert_tail costs O(delta) — the arranged delta BECOMES the next
+    # slot (one switch + pad; no merge into a big run per step) — and
+    # the level-0 fold tree-merges the slots into runs_b[0] every
+    # compact_every steps. `cursor` (device scalar) picks the slot.
+    slots: tuple = ()
+    cursor: object = None  # int32 scalar when slots != ()
 
     def tree_flatten(self):
-        return (self.runs_b,), (self.key, self.order)
+        if self.slots:
+            return (self.runs_b, self.slots, self.cursor), (
+                self.key, self.order, True,
+            )
+        return (self.runs_b,), (self.key, self.order, False)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        key, order = aux
+        key, order, has_slots = aux
+        if has_slots:
+            return cls(children[0], key, order, children[1], children[2])
         return cls(children[0], key, order)
 
     @property
@@ -246,19 +260,25 @@ class Spine:
     def with_run(self, i: int, batch: Batch) -> "Spine":
         rs = list(self.runs_b)
         rs[i] = batch
-        return Spine(tuple(rs), self.key, self.order)
+        return Spine(
+            tuple(rs), self.key, self.order, self.slots, self.cursor
+        )
 
     def runs(self) -> tuple:
         """Single-run Arrangement views for lookup/probe code (base
-        first, then progressively smaller runs)."""
+        first, then progressively smaller runs, then ingest slots)."""
         return tuple(
             Arrangement(b, self.key, self.order)
-            for b in reversed(self.runs_b)
+            for b in tuple(reversed(self.runs_b)) + self.slots
         )
 
     def map_batches(self, fn) -> "Spine":
         return Spine(
-            tuple(fn(b) for b in self.runs_b), self.key, self.order
+            tuple(fn(b) for b in self.runs_b),
+            self.key,
+            self.order,
+            tuple(fn(b) for b in self.slots),
+            self.cursor,
         )
 
     @staticmethod
@@ -270,28 +290,82 @@ class Spine:
         order: str = "exact",
         levels: int = 2,
         ratio: int = 8,
+        ingest_slots: int = 0,
     ) -> "Spine":
         """Capacities run geometrically from tail_capacity up, with the
-        base pinned at ``capacity``."""
+        base pinned at ``capacity``. ``ingest_slots`` > 0 adds an
+        append-slot ring of that many tail_capacity slots."""
         assert levels >= 2
         caps = [tail_capacity * (ratio**i) for i in range(levels - 1)]
         caps.append(capacity)  # base pinned exactly (callers may size
         # it below the mids deliberately to provoke overflow growth)
+        # Slots are null-canonicalized up front: they ride scan carries,
+        # whose pytree structure must not change when an insert lands.
+        slots = tuple(
+            Batch.empty(schema, tail_capacity).canonicalize_nulls()
+            for _ in range(ingest_slots)
+        )
+        cursor = (
+            jnp.asarray(0, jnp.int32) if ingest_slots else None
+        )
         return Spine(
             tuple(Batch.empty(schema, c) for c in caps),
             tuple(key),
             order,
+            slots,
+            cursor,
         )
 
 
 def insert_tail(spine: Spine, delta: Batch) -> tuple[Spine, jnp.ndarray]:
-    """Merge a delta batch into the spine's smallest run only — the
-    hot-path insert. O(runs_b[0] capacity); every other run passes
-    through untouched (no copy: same buffers).
+    """Absorb a delta batch — the hot-path insert.
 
-    Returns (new_spine, tail_overflowed). On overflow the host grows the
-    tail tier (or compacts more often) and replays."""
+    With an append-slot ring: the arranged delta BECOMES slot
+    ``cursor`` (O(delta): a pad + one lax.switch placement; no merge
+    touches any run). Without slots: merge into the smallest run
+    (O(runs_b[0] capacity)). Every other run passes through untouched
+    (no copy: same buffers).
+
+    Returns (new_spine, overflowed). On overflow the host grows the
+    slot/tail tier (or compacts more often) and replays."""
     d = arrange(delta, spine.key, capacity=None, order=spine.order)
+    if spine.slots:
+        slot_cap = spine.slots[0].capacity
+        nb = d.batch
+        overflow = nb.count > slot_cap
+        if nb.capacity < slot_cap:
+            nb = nb.with_capacity(slot_cap)
+        elif nb.capacity > slot_cap:
+            from ..ops.sort import shrink
+
+            nb, sovf = shrink(nb, slot_cap)
+            overflow = jnp.logical_or(overflow, sovf)
+        # Uniform slot pytree structure: canonical null masks, no
+        # producer hints (hints are aux metadata; a hinted batch would
+        # differ structurally from the empty slots in switch branches
+        # and scan carries).
+        nb = nb.canonicalize_nulls().replace(hints=())
+        s = len(spine.slots)
+        idx = spine.cursor % s
+
+        def place(k):
+            def f():
+                out = list(
+                    sl.canonicalize_nulls() for sl in spine.slots
+                )
+                out[k] = nb
+                return tuple(out)
+
+            return f
+
+        new_slots = jax.lax.switch(
+            idx, [place(k) for k in range(s)]
+        )
+        new = Spine(
+            spine.runs_b, spine.key, spine.order, new_slots,
+            spine.cursor + 1,
+        )
+        return new, overflow
     tail = spine.tail
     tail_arr = Arrangement(tail, spine.key, spine.order)
     merged, overflow = merge_sorted(
@@ -305,13 +379,78 @@ def insert_tail(spine: Spine, delta: Batch) -> tuple[Spine, jnp.ndarray]:
     return spine.with_run(0, cons), overflow
 
 
+def _tree_merge(batches: list, key, order) -> Batch:
+    """Pairwise merge a list of sorted batches into one sorted batch
+    (capacity = sum; never overflows)."""
+    while len(batches) > 1:
+        nxt = []
+        for i in range(0, len(batches) - 1, 2):
+            a, b = batches[i], batches[i + 1]
+            aa = Arrangement(a, key, order)
+            ba = Arrangement(b, key, order)
+            m, _ = merge_sorted(
+                a, aa.sort_lanes(), b, ba.sort_lanes(),
+                a.capacity + b.capacity,
+            )
+            nxt.append(m)
+        if len(batches) % 2:
+            nxt.append(batches[-1])
+        batches = nxt
+    return batches[0]
+
+
+def flush_slots(spine: Spine) -> tuple[Spine, jnp.ndarray]:
+    """Fold the append-slot ring into runs_b[0]: tree-merge the slots,
+    merge the result into run 0, clear the ring. Returns (new_spine,
+    run-0 overflow)."""
+    if not spine.slots:
+        return spine, jnp.asarray(False)
+    merged_slots = _tree_merge(
+        list(spine.slots), spine.key, spine.order
+    )
+    r0 = spine.runs_b[0]
+    r0_arr = Arrangement(r0, spine.key, spine.order)
+    m_arr = Arrangement(merged_slots, spine.key, spine.order)
+    merged, overflow = merge_sorted(
+        r0, r0_arr.sort_lanes(),
+        merged_slots, m_arr.sort_lanes(),
+        r0.capacity,
+    )
+    cons = consolidate_sorted(merged)
+    cleared = tuple(
+        s.replace(count=jnp.zeros_like(s.count)) for s in spine.slots
+    )
+    return (
+        Spine(
+            (cons,) + spine.runs_b[1:], spine.key, spine.order,
+            cleared, jnp.zeros_like(spine.cursor),
+        ),
+        overflow,
+    )
+
+
+def compact_depth(spine: Spine) -> int:
+    """Number of fold levels this spine has (max compact_level index
+    is compact_depth - 1). A slotted spine has one extra level: level
+    0 is the slot flush; level l>0 folds run l-1 into run l."""
+    return spine.levels - 1 + (1 if spine.slots else 0)
+
+
 def compact_level(spine: Spine, level: int) -> tuple[Spine, jnp.ndarray]:
-    """Fold run ``level`` into run ``level+1`` (the geometric ladder
-    step). Sort-free: runs share the spine's order, so the merge is a
-    binary search + one row-gather per dtype family, and duplicate
-    summation is the exact adjacent comparison. Returns (new_spine,
-    overflowed) where the flag is level+1's capacity overflow."""
-    lo, hi = spine.runs_b[level], spine.runs_b[level + 1]
+    """Fold one ladder level. Slotless: run `level` -> run `level+1`.
+    Slotted: level 0 flushes the append-slot ring into run 0; level
+    l>0 folds run l-1 into run l. Sort-free: runs share the spine's
+    order, so the merge is a binary search + one row-gather per dtype
+    family, and duplicate summation is the exact adjacent comparison.
+    Returns (new_spine, overflowed) where the flag is the TARGET run's
+    capacity overflow."""
+    if spine.slots:
+        if level == 0:
+            return flush_slots(spine)
+        lo_i, hi_i = level - 1, level
+    else:
+        lo_i, hi_i = level, level + 1
+    lo, hi = spine.runs_b[lo_i], spine.runs_b[hi_i]
     lo_arr = Arrangement(lo, spine.key, spine.order)
     hi_arr = Arrangement(hi, spine.key, spine.order)
     merged, overflow = merge_sorted(
@@ -322,21 +461,21 @@ def compact_level(spine: Spine, level: int) -> tuple[Spine, jnp.ndarray]:
         hi.capacity,
     )
     cons = consolidate_sorted(merged)
-    out = spine.with_run(level + 1, cons)
+    out = spine.with_run(hi_i, cons)
     out = out.with_run(
-        level, lo.replace(count=jnp.zeros_like(lo.count))
+        lo_i, lo.replace(count=jnp.zeros_like(lo.count))
     )
     return out, overflow
 
 
 def compact_spine(spine: Spine):
-    """Full cascade: fold every run into the base (peeks and snapshots
-    read the base as THE consolidated state). Cascades bottom-up
-    (run0 -> run1, then run1 -> run2, ...) so the base absorbs
-    everything in levels-1 merges. Returns (new_spine, overflow flags
-    [levels-1], one per target run, smallest target first)."""
+    """Full cascade: fold every slot and run into the base (peeks and
+    snapshots read the base as THE consolidated state). Cascades
+    bottom-up so the base absorbs everything. Returns (new_spine,
+    overflow flags [compact_depth], one per target run, smallest
+    target first)."""
     flags = []
-    for level in range(spine.levels - 1):
+    for level in range(compact_depth(spine)):
         spine, ovf = compact_level(spine, level)
         flags.append(ovf)
     return spine, jnp.stack(flags)

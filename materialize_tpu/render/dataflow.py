@@ -891,7 +891,9 @@ class _DataflowBase:
     pipelined run (device->host transfers through the TPU tunnel are the
     latency cost center, so the hot loop never reads data back)."""
 
-    def _init_output(self, capacity: int = 256, levels: int = 2):
+    def _init_output(
+        self, capacity: int = 256, levels: int = 2, slots: int = 0
+    ):
         from ..repr.schema import ERR_SCHEMA
 
         out_key = tuple(range(self.out_schema.arity))
@@ -907,6 +909,7 @@ class _DataflowBase:
             tail_capacity=self._ctx.out_delta_cap,
             order="hash",
             levels=levels,
+            ingest_slots=slots,
         )
         # The err collection: scalar-evaluation errors maintained next
         # to the data output (ok/err pair, render.rs:12-101). Reads
@@ -952,15 +955,21 @@ class _DataflowBase:
         """Overflow-flag keys of the compact program (per-target-run
         growth across every spine level), in the deterministic order
         every compact variant packs them (variants that do not touch a
-        level pack False for it — flag shape is uniform)."""
+        level pack False for it — flag shape is uniform). A slotted
+        spine's level-0 flush targets run 0, so its keys start at run
+        index 0; slotless spines' first target is run 1."""
+        from ..arrangement.spine import compact_depth
+
         keys = []
         for slot, parts in enumerate(self.states):
             for p, s in enumerate(parts):
                 if isinstance(s, Spine):
-                    for ri in range(1, s.levels):
-                        keys.append(("state", slot, (p, ri)))
-        for ri in range(1, self.output.levels):
-            keys.append(("out", ri))
+                    first = 0 if s.slots else 1
+                    for lvl in range(compact_depth(s)):
+                        keys.append(("state", slot, (p, first + lvl)))
+        first = 0 if self.output.slots else 1
+        for lvl in range(compact_depth(self.output)):
+            keys.append(("out", first + lvl))
         return keys
 
     def _due_levels(self, tick: int) -> int:
@@ -1032,7 +1041,18 @@ class _DataflowBase:
         self, spine: Spine, which, target: int | None = None
     ) -> Spine:
         """Grow one run of a spine. `which` is a run index, or the
-        legacy aliases "base" (largest run) / "tail" (run 0)."""
+        aliases "base" (largest run) / "tail" (the ingest tier: the
+        slot ring when present, else run 0)."""
+        if which == "tail" and spine.slots:
+            return Spine(
+                spine.runs_b,
+                spine.key,
+                spine.order,
+                tuple(
+                    self._grow_batch(s, target) for s in spine.slots
+                ),
+                spine.cursor,
+            )
         if which == "base":
             which = spine.levels - 1
         elif which == "tail":
@@ -1040,6 +1060,24 @@ class _DataflowBase:
         return spine.with_run(
             which, self._grow_batch(spine.runs_b[which], target)
         )
+
+    def _check_slot_ring(self) -> None:
+        """The append-slot ring must hold every insert between level-0
+        flushes: a ring smaller than _compact_every would silently
+        overwrite unflushed slots (the cursor wraps; no overflow flag
+        can catch it)."""
+        for sp in [self.output] + [
+            s
+            for parts in self.states
+            for s in parts
+            if isinstance(s, Spine)
+        ]:
+            if sp.slots and len(sp.slots) < self._compact_every:
+                raise ValueError(
+                    f"ingest slot ring ({len(sp.slots)}) smaller than "
+                    f"compact_every ({self._compact_every}): inserts "
+                    "would overwrite unflushed slots"
+                )
 
     def step(self, inputs: dict) -> Batch:
         """Feed one micro-batch of updates per source; returns the output
@@ -1148,6 +1186,8 @@ class _DataflowBase:
         """Trace body of the compact program (single-device layout).
         Walks the static state layout; only Spine parts are touched —
         levels [0, max_level] of each (clamped to the spine's depth)."""
+        from ..arrangement.spine import compact_depth
+
         flags = {}
         new_states = []
         for slot, parts in enumerate(states):
@@ -1155,15 +1195,19 @@ class _DataflowBase:
             for p, s in enumerate(ps):
                 if isinstance(s, Spine):
                     sp = s
-                    for lvl in range(min(max_level + 1, sp.levels - 1)):
+                    first = 0 if sp.slots else 1
+                    for lvl in range(
+                        min(max_level + 1, compact_depth(sp))
+                    ):
                         sp, ovf = compact_level(sp, lvl)
-                        flags[("state", slot, (p, lvl + 1))] = ovf
+                        flags[("state", slot, (p, first + lvl))] = ovf
                     ps[p] = sp
             new_states.append(tuple(ps))
         new_out = output
-        for lvl in range(min(max_level + 1, output.levels - 1)):
+        first = 0 if output.slots else 1
+        for lvl in range(min(max_level + 1, compact_depth(output))):
             new_out, ovf = compact_level(new_out, lvl)
-            flags[("out", lvl + 1)] = ovf
+            flags[("out", first + lvl)] = ovf
         packed = jnp.stack(
             [
                 jnp.asarray(
@@ -1275,12 +1319,14 @@ class _DataflowBase:
         return self.output.base
 
     def output_records(self) -> int:
-        """Approximate maintained row count (sum over all runs; may
-        overcount rows whose diffs cancel across runs until the next
-        compaction). Introspection only — one small d2h read."""
+        """Approximate maintained row count (sum over all runs and
+        ingest slots; may overcount rows whose diffs cancel across
+        runs until the next compaction). Introspection only — one
+        small d2h read."""
         return int(
             sum(
-                np.asarray(b.count).sum() for b in self.output.runs_b
+                np.asarray(b.count).sum()
+                for b in self.output.runs_b + self.output.slots
             )
         )
 
@@ -1310,6 +1356,7 @@ class _DataflowBase:
             # (constants fire exactly here; baked at trace time).
             self._first_time = int(self.time)
             self._ctx.first_time = self._first_time
+        self._check_slot_ring()
         packed = [self._pack_inputs(i) for i in inputs_list]
         env = self._build_env()
         if defer_check:
@@ -1367,11 +1414,13 @@ class _DataflowBase:
 
     def _max_compact_level(self) -> int:
         """Deepest fold index any spine in this dataflow can take."""
-        deepest = self.output.levels - 2
+        from ..arrangement.spine import compact_depth
+
+        deepest = compact_depth(self.output) - 1
         for parts in self.states:
             for s in parts:
                 if isinstance(s, Spine):
-                    deepest = max(deepest, s.levels - 2)
+                    deepest = max(deepest, compact_depth(s) - 1)
         return deepest
 
     def _make_span_jit(self, with_env: bool):
@@ -1390,14 +1439,40 @@ class _DataflowBase:
             def chunk_body(carry, xs):
                 chunk, lvl = xs
                 st, o, e, t = carry
-                # Only the spine's INGEST run rides the inner scan
-                # carry; upper runs are chunk-invariant (the step never
-                # touches them) and rejoin only for the compaction.
-                upper = o.runs_b[1:]
+                # Only the spine's INGEST tier rides the inner scan
+                # carry (the slot ring + cursor when present, else run
+                # 0); every other run is chunk-invariant (the step
+                # never touches it) and rejoins only for the
+                # compaction.
+                if o.slots:
+                    invariant = o.runs_b
+
+                    def rebuild(carried):
+                        slots, cursor = carried
+                        return Spine(
+                            invariant, o.key, o.order, slots, cursor
+                        )
+
+                    def extract(sp):
+                        return (sp.slots, sp.cursor)
+
+                    carried0 = (o.slots, o.cursor)
+                else:
+                    invariant = o.runs_b[1:]
+
+                    def rebuild(carried):
+                        return Spine(
+                            (carried,) + invariant, o.key, o.order
+                        )
+
+                    def extract(sp):
+                        return sp.runs_b[0]
+
+                    carried0 = o.runs_b[0]
 
                 def step_body(c2, x):
-                    st2, run0, e2, t2 = c2
-                    o2 = Spine((run0,) + upper, o.key, o.order)
+                    st2, ingest, e2, t2 = c2
+                    o2 = rebuild(ingest)
                     if env is not None:
                         out, ns, no, ne, nt, fl = self._step_core(
                             st2, o2, e2, x, t2, env
@@ -1406,12 +1481,12 @@ class _DataflowBase:
                         out, ns, no, ne, nt, fl = self._step_core(
                             st2, o2, e2, x, t2
                         )
-                    return (ns, no.runs_b[0], ne, nt), (out, fl)
+                    return (ns, extract(no), ne, nt), (out, fl)
 
-                (st, run0, e, t), (deltas, fls) = jax.lax.scan(
-                    step_body, (st, o.runs_b[0], e, t), chunk
+                (st, ingest, e, t), (deltas, fls) = jax.lax.scan(
+                    step_body, (st, carried0, e, t), chunk
                 )
-                o = Spine((run0,) + upper, o.key, o.order)
+                o = rebuild(ingest)
                 branches = [
                     (lambda s_, o_, m=m: self._compact_core_single(
                         s_, o_, m
@@ -1449,6 +1524,7 @@ class _DataflowBase:
         if getattr(self, "_first_time", None) is None:
             self._first_time = int(self.time)
             self._ctx.first_time = self._first_time
+        self._check_slot_ring()
         # Checkpoint BEFORE any dispatch (including the flush
         # compaction below): an overflow discovered at check_flags
         # time must be able to roll all of it back.
@@ -1560,7 +1636,8 @@ class Dataflow(_DataflowBase):
     """
 
     def __init__(self, expr: mir.RelationExpr, name: str = "df",
-                 state_cap: int = 256, out_levels: int = 2):
+                 state_cap: int = 256, out_levels: int = 2,
+                 out_slots: int = 0):
         from ..expr import strings
 
         self.expr = expr
@@ -1574,8 +1651,10 @@ class Dataflow(_DataflowBase):
         self.states = [s.init for s in ctx.slots]
         # Big output indexes run a deeper geometric run ladder
         # (out_levels=3-4) so base-scale merges amortize to every
-        # ratio^(levels-1) steps (spine.py).
-        self._init_output(levels=out_levels)
+        # ratio^(levels-1) steps, plus an append-slot ingest ring
+        # (out_slots=compact_every) for O(delta) per-step inserts
+        # (spine.py).
+        self._init_output(levels=out_levels, slots=out_slots)
         self.time = 0  # frontier: all steps < time are complete
         self._remake_jit()
 
