@@ -251,14 +251,15 @@ class ComputeController:
         self._broadcast(ctp.update_configuration(params))
 
     def peek(
-        self, dataflow: str, as_of: int | None, timeout: float = 30.0
+        self, dataflow: str, as_of: int | None, timeout: float = 30.0,
+        exact: bool = False,
     ):
         """Peek on every replica; first response wins
         (absorb_peek_response). Returns (rows, served_at)."""
         peek_id = next(self._peek_counter)
         ev = threading.Event()
         self._peek_events[peek_id] = ev
-        self._broadcast(ctp.peek(peek_id, dataflow, as_of))
+        self._broadcast(ctp.peek(peek_id, dataflow, as_of, exact))
         try:
             if not ev.wait(timeout):
                 raise TimeoutError(

@@ -717,12 +717,16 @@ class Coordinator:
         return ts
 
     # -- read-then-write DML ---------------------------------------------------
-    def _transient_peek(self, expr: mir.RelationExpr, unlocked: bool):
+    def _transient_peek(
+        self, expr: mir.RelationExpr, unlocked: bool,
+        as_of: int | None = None,
+    ):
         """Install a transient dataflow, peek it at the sources' latest
-        complete time, drop it; returns raw (vals..., time, diff) rows.
-        ``unlocked`` releases the sequencing lock during the wait —
-        safe for SELECT, NOT for DML whose read must be atomic with its
-        write."""
+        complete time (or exactly ``as_of`` when given: AS OF hydrates
+        the dataflow at t — inputs must be readable there), drop it;
+        returns raw (vals..., time, diff) rows. ``unlocked`` releases
+        the sequencing lock during the wait — safe for SELECT, NOT for
+        DML whose read must be atomic with its write."""
         imports, index_imports = self._source_imports(expr)
         self._transient_seq += 1
         name = f"t{self._transient_seq}"
@@ -730,21 +734,28 @@ class Coordinator:
             DataflowDescription(
                 name=name, expr=expr, source_imports=imports,
                 sink_shard=None, index_imports=index_imports,
+                as_of=as_of,
             ),
             unlocked=unlocked,
         )
         try:
-            as_of = self._select_timestamp_shards(
-                self._df_upstream.get(name, [])
-            )
+            if as_of is not None:
+                as_of_sel, exact = as_of, True
+            else:
+                as_of_sel = self._select_timestamp_shards(
+                    self._df_upstream.get(name, [])
+                )
+                exact = False
             if unlocked:
                 with self._unlocked():
                     rows, _ = self.controller.peek(
-                        name, as_of=as_of, timeout=PEEK_TIMEOUT
+                        name, as_of=as_of_sel, timeout=PEEK_TIMEOUT,
+                        exact=exact,
                     )
             else:
                 rows, _ = self.controller.peek(
-                    name, as_of=as_of, timeout=PEEK_TIMEOUT
+                    name, as_of=as_of_sel, timeout=PEEK_TIMEOUT,
+                    exact=exact,
                 )
         finally:
             # Deregister FIRST: the dict pops cannot fail, while
@@ -848,6 +859,7 @@ class Coordinator:
 
         name = f"sub{self._sub_seq}-{uuid.uuid4().hex[:8]}"
         shard = f"{name}_out"
+        as_of = getattr(plan, "as_of", None)
         self._register_dataflow(
             DataflowDescription(
                 name=name,
@@ -855,10 +867,11 @@ class Coordinator:
                 source_imports=imports,
                 sink_shard=shard,
                 index_imports=index_imports,
+                as_of=as_of,
             )
         )
         sub = Subscription(self, name, shard, expr.schema(),
-                           plan.column_names)
+                           plan.column_names, as_of=as_of)
         self.subscriptions[self._sub_seq] = sub
         res = ExecuteResult("subscription", columns=plan.column_names)
         res.subscription = sub
@@ -1248,14 +1261,22 @@ class Coordinator:
         # dataflow's frontier to pass it (freshness: the read is
         # linearizable w.r.t. ingested data, not merely whatever the
         # dataflow happens to have processed).
+        as_of_req = getattr(plan, "as_of", None)
         if isinstance(expr, mir.Get) and expr.name in self.peekable:
             df = self.peekable[expr.name]
-            as_of = self._select_timestamp_shards(
-                self._df_upstream.get(df, [])
-            )
+            if as_of_req is not None:
+                # AS OF: serve at exactly the requested time (a rewind
+                # inside the dataflow's multiversion window, or an
+                # error outside it).
+                as_of, exact = as_of_req, True
+            else:
+                as_of = self._select_timestamp_shards(
+                    self._df_upstream.get(df, [])
+                )
+                exact = False
             with self._unlocked():
                 rows, _ = self.controller.peek(
-                    df, as_of=as_of, timeout=PEEK_TIMEOUT
+                    df, as_of=as_of, timeout=PEEK_TIMEOUT, exact=exact
                 )
             return ExecuteResult(
                 "rows",
@@ -1267,7 +1288,7 @@ class Coordinator:
             )
         # Slow path: transient dataflow, peek, drop (life-of-a-query
         # slow path).
-        rows = self._transient_peek(expr, unlocked=True)
+        rows = self._transient_peek(expr, unlocked=True, as_of=as_of_req)
         return ExecuteResult(
             "rows",
             rows=_finish(rows, plan.order_by,
@@ -1358,13 +1379,17 @@ class Subscription:
     the dataflow's sink shard gives exactly-once delivery across
     coordinator restarts."""
 
-    def __init__(self, coord, df_name, shard, schema, columns):
+    def __init__(self, coord, df_name, shard, schema, columns,
+                 as_of: int | None = None):
         self.coord = coord
         self.df_name = df_name
         self.reader = coord.persist.open_reader(shard, f"sub-{df_name}")
         self.schema = schema
         self.columns = columns
-        self.frontier = 0
+        # SUBSCRIBE ... AS OF t: the dataflow hydrated at exactly t (the
+        # sink's first chunk is the collapsed snapshot at t); emit that
+        # snapshot first, then tail deltas beyond it.
+        self.frontier = 0 if as_of is None else as_of
         self.closed = False
 
     def poll(self, timeout: float = 5.0):

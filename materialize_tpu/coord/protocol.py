@@ -110,6 +110,11 @@ class DataflowDescription:
     # publisher's sources, and steady-state deltas are pushed
     # step-by-step.
     index_imports: dict = field(default_factory=dict)
+    # Explicit hydration timestamp (SELECT/SUBSCRIBE ... AS OF t): the
+    # view hydrates its inputs at exactly t instead of as-of selection's
+    # latest readable time (compute-client/src/as_of_selection.rs when
+    # an AS OF is user-specified). Inputs must be readable at t.
+    as_of: "int | None" = None
 
     def fingerprint(self) -> bytes:
         return pickle.dumps(
@@ -119,6 +124,7 @@ class DataflowDescription:
                 sorted(self.source_imports.items()),
                 self.sink_shard,
                 sorted(self.index_imports.items()),
+                self.as_of,
             ),
             protocol=pickle.HIGHEST_PROTOCOL,
         )
@@ -141,10 +147,15 @@ def drop_dataflow(name: str) -> dict:
     return {"kind": "DropDataflow", "name": name}
 
 
-def peek(peek_id: int, dataflow: str, as_of: int | None) -> dict:
+def peek(
+    peek_id: int, dataflow: str, as_of: int | None, exact: bool = False
+) -> dict:
+    """``exact`` = serve at exactly ``as_of`` (AS OF semantics: rewind
+    inside the multiversion window); default serves the latest complete
+    result once the frontier passes ``as_of``."""
     return {
         "kind": "Peek", "peek_id": peek_id, "dataflow": dataflow,
-        "as_of": as_of,
+        "as_of": as_of, "exact": exact,
     }
 
 

@@ -335,6 +335,7 @@ class ReplicaWorker:
                         desc.sink_shard,
                         index_sources=index_sources,
                         replica_id=self.replica_id,
+                        as_of=getattr(desc, "as_of", None),
                     ),
                 )
             except (SinkConflict, Fenced, ValueError) as e:
@@ -667,6 +668,45 @@ class ReplicaWorker:
                         "kind": "PeekResponse",
                         "peek_id": p["peek_id"],
                         "error": f"Evaluation error: {msg}",
+                        "replica_id": self.replica_id,
+                    },
+                )
+                served = True
+                continue
+            exact = bool(p.get("exact")) and as_of is not None
+            if exact and as_of != inst.view.upper - 1:
+                # AS OF inside the multiversion window: rewind the
+                # maintained result by the retained deltas in
+                # (as_of, upper) instead of serving the live frontier.
+                from ..repr.schema import decode_result_rows
+                from ..storage.persist.operators import AsOfError
+
+                try:
+                    cols, nulls, time, diff = inst.view.updates_as_of(
+                        as_of
+                    )
+                    rows = decode_result_rows(
+                        inst.view.df.out_schema, cols, nulls, time, diff
+                    )
+                except AsOfError as e:
+                    ctp.send_msg(
+                        conn,
+                        {
+                            "kind": "PeekResponse",
+                            "peek_id": p["peek_id"],
+                            "error": str(e),
+                            "replica_id": self.replica_id,
+                        },
+                    )
+                    served = True
+                    continue
+                ctp.send_msg(
+                    conn,
+                    {
+                        "kind": "PeekResponse",
+                        "peek_id": p["peek_id"],
+                        "rows": rows,
+                        "served_at": as_of,
                         "replica_id": self.replica_id,
                     },
                 )

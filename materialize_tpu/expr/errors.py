@@ -34,6 +34,10 @@ MESSAGES = {
     NUMERIC_OUT_OF_RANGE: "numeric field overflow",
 }
 
+# Fixed-shape error-count vectors (LetRec carries one through its
+# while_loop) are indexed by code; codes must stay small and dense.
+N_CODES = max(MESSAGES) + 1
+
 
 _tls = threading.local()
 

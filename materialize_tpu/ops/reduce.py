@@ -83,6 +83,29 @@ def _accum_cols(j: int, agg: AggregateExpr, input_schema: Schema):
     )
 
 
+# |running sum| beyond this bound is a NUMERIC_OUT_OF_RANGE error (the
+# reference accumulates i64 sums into i128 and errors when the result
+# leaves i64, render/reduce.rs Accum; here the guard band is half the
+# i64 range so per-step deltas cannot silently lap the detector).
+_SUM_ERR_BOUND = 1 << 62
+
+
+def _sum_err_batch(trans, out_time) -> "Batch":
+    """One err-stream update row carrying the net count of groups whose
+    running sum crossed (+1) or re-entered (-1) the bound this step."""
+    from ..expr.errors import NUMERIC_OUT_OF_RANGE
+    from ..repr.schema import ERR_SCHEMA
+
+    return Batch(
+        cols=(jnp.full(1, NUMERIC_OUT_OF_RANGE, jnp.int64),),
+        nulls=(None,),
+        time=jnp.full(1, out_time, jnp.uint64),
+        diff=trans.reshape(1).astype(jnp.int64),
+        count=jnp.asarray(1, jnp.int32),
+        schema=ERR_SCHEMA,
+    )
+
+
 # splitmix64 finalizer constants: the digest must be non-linear in the
 # values so structurally related multisets (same count and sum) do not
 # collide — a plain sum would make {1,4} and {2,3} indistinguishable.
@@ -539,6 +562,41 @@ class ReduceOp:
         ]
         old_alive = jnp.logical_and(gvalid, old_accums[0] > 0)
         new_alive = jnp.logical_and(gvalid, new_accums[0] > 0)
+
+        # Sum-overflow error stream (round-4 verdict ask #6; reference
+        # render.rs:12-101 err collections + reduce.rs i128 Accum): a
+        # group whose |running sum| crosses the bound contributes an
+        # error row; retracting inputs brings the modular sum back into
+        # range and RETRACTS the error (int64 addition is a group, so
+        # wrapped state recovers exactly). Maintained incrementally:
+        # only touched groups can transition.
+        from ..expr import errors as _errors
+
+        if _errors.step_active() and any(
+            a.func is AggregateFunc.SUM_INT for _, a in self.acc_like
+        ):
+            off = 1  # skip __rows__
+            trans = jnp.zeros((), jnp.int64)
+            for _j, agg in self.acc_like:
+                width = len(
+                    _accum_cols(_j, agg, self.input_schema)
+                )
+                if agg.func is AggregateFunc.SUM_INT:
+                    o, n = old_accums[off], new_accums[off]
+                    # not abs(): |int64 min| wraps negative
+                    was = jnp.logical_or(
+                        o > _SUM_ERR_BOUND, o < -_SUM_ERR_BOUND
+                    )
+                    now = jnp.logical_or(
+                        n > _SUM_ERR_BOUND, n < -_SUM_ERR_BOUND
+                    )
+                    trans = trans + jnp.where(
+                        gvalid,
+                        now.astype(jnp.int64) - was.astype(jnp.int64),
+                        0,
+                    ).sum()
+                off += width
+            _errors.push_step(_sum_err_batch(trans, out_time))
 
         overflow = {}
         new_state_acc, overflow[0] = merge_accum_state(

@@ -46,6 +46,7 @@ from ..arrangement.spine import (
     insert_tail,
 )
 from ..expr import relation as mir
+from ..expr.errors import N_CODES as _N_ERR_CODES
 from ..expr.linear import MapFilterProject, apply_mfp
 from ..ops.consolidate import consolidate
 from ..ops.delta_join import DeltaJoinOp
@@ -627,18 +628,28 @@ def _build_letrec(expr: mir.LetRec, ctx: _RenderContext):
             return out
 
         def run_values(states_l, it_inputs):
-            """One iteration: returns (new_states_list, deltas, ovf dict).
+            """One iteration: returns (new_states_list, deltas, ovf
+            dict, err-count vector [N_ERR_CODES]).
 
-            Error-stream masks raised INSIDE the fixpoint are contained
-            in a local sink and dropped: values created inside the
-            while_loop trace cannot ride the outer step's err collection
-            (they would escape the loop as leaked tracers). Documented
-            v1 limitation: scalar-eval errors inside WITH MUTUALLY
-            RECURSIVE values do not reach the err output."""
+            Error-stream batches raised INSIDE the fixpoint cannot ride
+            the outer step's Python-list err sink (values created in
+            the while_loop trace would escape the loop as leaked
+            tracers). Instead they fold into a fixed-shape per-code
+            count vector that RIDES THE LOOP CARRY; the outer run()
+            converts the final counts into err update rows
+            (render.rs:12-101 — LetRec-internal errors reach the err
+            collection, and retract: a deletion re-evaluates the site
+            with diff=-1)."""
             from ..expr import errors as _errors
 
-            with _errors.step_scope():
-                return _run_values_inner(states_l, it_inputs)
+            with _errors.step_scope() as sink:
+                sts, deltas_i, ovf_i = _run_values_inner(
+                    states_l, it_inputs
+                )
+            errs = jnp.zeros((_N_ERR_CODES,), jnp.int64)
+            for eb in sink:
+                errs = errs.at[eb.cols[0]].add(eb.diff)
+            return sts, deltas_i, ovf_i, errs
 
         def _run_values_inner(states_l, it_inputs):
             states_l = list(states_l)
@@ -651,6 +662,13 @@ def _build_letrec(expr: mir.LetRec, ctx: _RenderContext):
                 ovf.update(o)
                 d = consolidate(d, include_time=False)
                 d, so = shrink(d, cap)
+                if d.capacity != cap:
+                    # Loop-carry invariant: binding deltas/accums must
+                    # sit at EXACTLY the site cap — a value expr whose
+                    # output tier is below cap would otherwise make
+                    # iteration-0 accums smaller than the body's
+                    # concat+shrink output (while_loop type mismatch).
+                    d = d.with_capacity(cap)
                 ovf[("lr", site, i)] = so
                 # Rebrand to the DECLARED binding schema (value exprs may
                 # produce equivalent columns under different names).
@@ -663,7 +681,9 @@ def _build_letrec(expr: mir.LetRec, ctx: _RenderContext):
         it0_inputs = dict(inputs)
         for nm, sch in zip(names, schemas):
             it0_inputs[nm] = Batch.empty(sch, cap)
-        states_l, deltas, ovf = run_values(list(states), it0_inputs)
+        states_l, deltas, ovf, errs0 = run_values(
+            list(states), it0_inputs
+        )
         accums = list(deltas)
 
         ovf_keys = sorted(ovf.keys())
@@ -681,7 +701,7 @@ def _build_letrec(expr: mir.LetRec, ctx: _RenderContext):
         }
 
         def cond(carry):
-            _, deltas_c, _, it, _ = carry
+            _, deltas_c, _, it, _, _ = carry
             pending = jnp.asarray(0, jnp.int32)
             for d in deltas_c:
                 pending = pending + d.count.reshape(()).astype(jnp.int32)
@@ -690,11 +710,13 @@ def _build_letrec(expr: mir.LetRec, ctx: _RenderContext):
             return jnp.logical_and(it < max_iters, pending > 0)
 
         def body(carry):
-            states_c, deltas_c, accums_c, it, ovf_c = carry
+            states_c, deltas_c, accums_c, it, ovf_c, errs_c = carry
             it_inputs = dict(empty_inputs)
             for nm, d in zip(names, deltas_c):
                 it_inputs[nm] = d
-            states_n, new_deltas, o = run_values(list(states_c), it_inputs)
+            states_n, new_deltas, o, errs_n = run_values(
+                list(states_c), it_inputs
+            )
             new_accums = []
             for i, (a, d) in enumerate(zip(accums_c, new_deltas)):
                 m = consolidate(
@@ -710,6 +732,7 @@ def _build_letrec(expr: mir.LetRec, ctx: _RenderContext):
                 tuple(new_accums),
                 it + 1,
                 jnp.logical_or(ovf_c, pack(o)),
+                errs_c + errs_n,
             )
 
         carry0 = (
@@ -718,10 +741,32 @@ def _build_letrec(expr: mir.LetRec, ctx: _RenderContext):
             tuple(accums),
             jnp.asarray(1, jnp.int32),
             pack(ovf),
+            errs0,
         )
-        states_f, _, accums_f, _, ovf_f = jax.lax.while_loop(
+        states_f, _, accums_f, _, ovf_f, errs_f = jax.lax.while_loop(
             cond, body, carry0
         )
+        # Surface the fixpoint's accumulated per-code error counts into
+        # the OUTER step's err collection (zero-diff rows consolidate
+        # away downstream).
+        from ..expr import errors as _errors
+        from ..repr.schema import ERR_SCHEMA
+
+        if _errors.step_active():
+            _errors.push_step(
+                Batch(
+                    cols=(
+                        jnp.arange(_N_ERR_CODES, dtype=jnp.int64),
+                    ),
+                    nulls=(None,),
+                    time=jnp.full(
+                        _N_ERR_CODES, time, dtype=jnp.uint64
+                    ),
+                    diff=errs_f,
+                    count=jnp.asarray(_N_ERR_CODES, jnp.int32),
+                    schema=ERR_SCHEMA,
+                )
+            )
 
         # Body consumes real inputs + the per-step total binding deltas.
         body_inputs = dict(inputs)
@@ -1132,6 +1177,204 @@ class _DataflowBase:
         for r in rows:
             acc[r[0]] = acc.get(r[0], 0) + r[-1]
         return sorted((c, n) for c, n in acc.items() if n != 0)
+
+    # -- basic-aggregate edge finalization ---------------------------------
+    # Shared by single-device and sharded dataflows (sharded overrides
+    # _basic_multiset_host with a per-worker gather — the reduce input
+    # exchange keys groups to one worker, so shards concatenate into a
+    # group-contiguous multiset). render/reduce.rs:369 analog.
+
+    def _basic_multiset_host(self, arr) -> dict:
+        """Host view of one basic-aggregate multiset arrangement."""
+        b = arr.batch
+        n = int(b.count)
+        return {
+            "n": n,
+            "cols": [np.asarray(c)[:n] for c in b.cols],
+            "nulls": [
+                None if x is None else np.asarray(x)[:n]
+                for x in b.nulls
+            ],
+            "diff": np.asarray(b.diff)[:n],
+        }
+
+    def capture_basic_multisets(self) -> dict:
+        """Pre-step host snapshot of every basic multiset part: the
+        persist-sink delta path finalizes RETRACTION rows against the
+        state their digests describe (the post-step multiset no longer
+        holds it)."""
+        out: dict = {}
+        for fi, (_oc, slot, part, *_rest) in enumerate(
+            self._basic_finalizers
+        ):
+            out[fi] = self._basic_multiset_host(
+                self.states[slot][part]
+            )
+        return out
+
+    def _basic_group_maps(self, multisets: dict | None = None) -> list:
+        """Per-finalizer (by_digest, by_key) result-lookup maps built
+        from the multiset state (or from pre-captured host views)."""
+        from ..ops.reduce import _NULL_DIGEST, _mix64_host
+        from ..repr.schema import GLOBAL_DICT
+
+        gdict = GLOBAL_DICT.snapshot()
+        maps: list = []
+        for fi, (
+            out_col, slot, part, agg, vcol, key_out
+        ) in enumerate(self._basic_finalizers):
+            arr = self.states[slot][part]
+            b = (
+                multisets[fi]
+                if multisets is not None
+                else self._basic_multiset_host(arr)
+            )
+            bcols, bnulls, diffs = b["cols"], b["nulls"], b["diff"]
+            keep = diffs != 0
+            n_key = len(arr.key)
+            vals = bcols[n_key][keep].astype(np.int64)
+            vnl = bnulls[n_key]
+            vnl = vnl[keep] if vnl is not None else None
+            mult = diffs[keep]
+            by_digest: dict = {}
+            by_key: dict = {}
+            if len(vals):
+                # Masked key columns, computed ONCE (the per-group loop
+                # below only indexes them — re-masking per group made
+                # finalization O(groups * rows)).
+                kcols = [bcols[ki][keep] for ki in range(n_key)]
+                knulls = [
+                    None if bnulls[ki] is None else bnulls[ki][keep]
+                    for ki in range(n_key)
+                ]
+                # Group boundaries: multiset rows sort by (key, value)
+                # with NULL keys canonicalized first, so groups are
+                # contiguous; compare raw values gated on null flags.
+                change = np.zeros(len(vals), dtype=bool)
+                change[0] = True
+                for kc, nl in zip(kcols, knulls):
+                    if nl is None:
+                        change[1:] |= kc[1:] != kc[:-1]
+                    else:
+                        both = ~nl[1:] & ~nl[:-1]
+                        change[1:] |= (nl[1:] != nl[:-1]) | (
+                            both & (kc[1:] != kc[:-1])
+                        )
+                starts = np.flatnonzero(change)
+                ends = np.append(starts[1:], len(vals))
+                m = _mix64_host(vals).astype(np.uint64)
+                if vnl is not None:
+                    m = np.where(
+                        vnl,
+                        np.uint64(np.int64(_NULL_DIGEST)),
+                        m,
+                    )
+                m = m * mult.astype(np.uint64)
+                for s0, e0 in zip(starts, ends):
+                    dig = int(
+                        m[s0:e0].sum(dtype=np.uint64).astype(np.int64)
+                    )
+                    res = _finalize_basic_value(
+                        agg, vcol, vals[s0:e0],
+                        vnl[s0:e0] if vnl is not None else None,
+                        mult[s0:e0], gdict,
+                    )
+                    by_digest[dig] = res
+                    if key_out is not None:
+                        kt = tuple(
+                            None
+                            if knulls[ki] is not None
+                            and bool(knulls[ki][s0])
+                            else kcols[ki][s0].item()
+                            for ki in range(n_key)
+                        )
+                        by_key[kt] = (dig, res)
+            maps.append((by_digest, by_key))
+        return maps
+
+    def finalize_basic_columns(
+        self, cols, nulls, diffs=None, old_multisets=None
+    ) -> list:
+        """Edge finalization of basic aggregates: replace each digest
+        value in the host output columns with the group's materialized
+        result STRING (object-dtype column; decode_result_rows passes
+        pre-decoded columns through — results never round-trip the
+        global dictionary, which peeks under churn would otherwise grow
+        without bound), computed from the maintained (key, value)
+        multiset state.
+
+        When every group-key column survives to the output, the lookup
+        is keyed by group key with the digest as a consistency check (a
+        64-bit digest collision between groups raises instead of
+        serving the wrong group's result); digest-only lookup is the
+        fallback for outputs that project keys away.
+
+        With ``diffs`` + ``old_multisets`` (the persist-sink delta
+        path), RETRACTION rows (diff < 0) resolve against the pre-step
+        maps — their digests describe group states the current multiset
+        no longer holds."""
+        if not self._basic_finalizers:
+            return list(cols)
+        new_maps = self._basic_group_maps()
+        old_maps = (
+            self._basic_group_maps(old_multisets)
+            if old_multisets is not None
+            else None
+        )
+        cols = list(cols)
+        for fi, (
+            out_col, slot, part, agg, vcol, key_out
+        ) in enumerate(self._basic_finalizers):
+            src = np.asarray(cols[out_col])
+            out = np.empty(len(src), dtype=object)
+            nl = nulls[out_col] if nulls else None
+            key_src = (
+                [np.asarray(cols[ko]) for ko in key_out]
+                if key_out is not None
+                else None
+            )
+            for i in range(len(src)):
+                if nl is not None and nl[i]:
+                    out[i] = None
+                    continue
+                retract = (
+                    diffs is not None
+                    and old_maps is not None
+                    and diffs[i] < 0
+                )
+                by_digest, by_key = (
+                    old_maps[fi] if retract else new_maps[fi]
+                )
+                d = int(src[i])
+                if key_out is not None:
+                    kt = tuple(
+                        None
+                        if nulls[ko] is not None and bool(nulls[ko][i])
+                        else key_src[kk][i].item()
+                        for kk, ko in enumerate(key_out)
+                    )
+                    hit = by_key.get(kt)
+                    if hit is None:
+                        raise RuntimeError(
+                            "basic-aggregate group has no multiset "
+                            "entry (state divergence)"
+                        )
+                    dig, res = hit
+                    if dig != d:
+                        raise RuntimeError(
+                            "basic-aggregate digest mismatch for group "
+                            f"{kt!r} (digest/multiset divergence)"
+                        )
+                    out[i] = res
+                else:
+                    if d not in by_digest:
+                        raise RuntimeError(
+                            "basic-aggregate digest has no multiset "
+                            "group (digest/multiset divergence)"
+                        )
+                    out[i] = by_digest[d]
+            cols[out_col] = out
+        return cols
 
     def _build_env(self):
         if getattr(self, "_str_keys", None):
@@ -1751,150 +1994,6 @@ class Dataflow(_DataflowBase):
             for row in zip(*cols)
         ]
 
-    def finalize_basic_columns(self, cols, nulls) -> list:
-        """Edge finalization of basic aggregates (render/reduce.rs:369
-        analog): replace each digest value in the host output columns
-        with the group's materialized result STRING (object-dtype
-        column; decode_result_rows passes pre-decoded columns through —
-        results never round-trip the global dictionary, which peeks
-        under churn would otherwise grow without bound), computed from
-        the maintained (key, value) multiset state.
-
-        When every group-key column survives to the output, the lookup
-        is keyed by group key with the digest as a consistency check
-        (a 64-bit digest collision between groups raises instead of
-        serving the wrong group's result); digest-only lookup is the
-        fallback for outputs that project keys away."""
-        if not self._basic_finalizers:
-            return list(cols)
-        from ..ops.reduce import _NULL_DIGEST, _mix64_host
-        from ..repr.schema import GLOBAL_DICT
-
-        gdict = GLOBAL_DICT.snapshot()
-        cols = list(cols)
-        for (
-            out_col, slot, part, agg, vcol, key_out
-        ) in self._basic_finalizers:
-            arr = self.states[slot][part]
-            b = self._basic_multiset_host(arr)
-            n = int(b["n"])
-            bcols, bnulls, diffs = b["cols"], b["nulls"], b["diff"]
-            keep = diffs != 0
-            n_key = len(arr.key)
-            vals = bcols[n_key][keep].astype(np.int64)
-            vnl = bnulls[n_key]
-            vnl = vnl[keep] if vnl is not None else None
-            mult = diffs[keep]
-            by_digest: dict = {}
-            by_key: dict = {}
-            if len(vals):
-                # Masked key columns, computed ONCE (the per-group loop
-                # below only indexes them — re-masking per group made
-                # finalization O(groups * rows)).
-                kcols = [bcols[ki][keep] for ki in range(n_key)]
-                knulls = [
-                    None if bnulls[ki] is None else bnulls[ki][keep]
-                    for ki in range(n_key)
-                ]
-                # Group boundaries: multiset rows sort by (key, value)
-                # with NULL keys canonicalized first, so groups are
-                # contiguous; compare raw values gated on null flags.
-                change = np.zeros(len(vals), dtype=bool)
-                change[0] = True
-                for kc, nl in zip(kcols, knulls):
-                    if nl is None:
-                        change[1:] |= kc[1:] != kc[:-1]
-                    else:
-                        both = ~nl[1:] & ~nl[:-1]
-                        change[1:] |= (nl[1:] != nl[:-1]) | (
-                            both & (kc[1:] != kc[:-1])
-                        )
-                starts = np.flatnonzero(change)
-                ends = np.append(starts[1:], len(vals))
-                m = _mix64_host(vals).astype(np.uint64)
-                if vnl is not None:
-                    m = np.where(
-                        vnl,
-                        np.uint64(np.int64(_NULL_DIGEST)),
-                        m,
-                    )
-                m = m * mult.astype(np.uint64)
-                for s0, e0 in zip(starts, ends):
-                    dig = int(
-                        m[s0:e0].sum(dtype=np.uint64).astype(np.int64)
-                    )
-                    res = _finalize_basic_value(
-                        agg, vcol, vals[s0:e0],
-                        vnl[s0:e0] if vnl is not None else None,
-                        mult[s0:e0], gdict,
-                    )
-                    by_digest[dig] = res
-                    if key_out is not None:
-                        kt = tuple(
-                            None
-                            if knulls[ki] is not None
-                            and bool(knulls[ki][s0])
-                            else kcols[ki][s0].item()
-                            for ki in range(n_key)
-                        )
-                        by_key[kt] = (dig, res)
-            src = np.asarray(cols[out_col])
-            out = np.empty(len(src), dtype=object)
-            nl = nulls[out_col] if nulls else None
-            key_src = (
-                [np.asarray(cols[ko]) for ko in key_out]
-                if key_out is not None
-                else None
-            )
-            for i in range(len(src)):
-                if nl is not None and nl[i]:
-                    out[i] = None
-                    continue
-                d = int(src[i])
-                if key_out is not None:
-                    kt = tuple(
-                        None
-                        if nulls[ko] is not None and bool(nulls[ko][i])
-                        else key_src[kk][i].item()
-                        for kk, ko in enumerate(key_out)
-                    )
-                    hit = by_key.get(kt)
-                    if hit is None:
-                        raise RuntimeError(
-                            "basic-aggregate group has no multiset "
-                            "entry (state divergence)"
-                        )
-                    dig, res = hit
-                    if dig != d:
-                        raise RuntimeError(
-                            "basic-aggregate digest mismatch for group "
-                            f"{kt!r} (digest/multiset divergence)"
-                        )
-                    out[i] = res
-                else:
-                    if d not in by_digest:
-                        raise RuntimeError(
-                            "basic-aggregate digest has no multiset "
-                            "group (digest/multiset divergence)"
-                        )
-                    out[i] = by_digest[d]
-            cols[out_col] = out
-        return cols
-
-    def _basic_multiset_host(self, arr) -> dict:
-        """Host view of one basic-aggregate multiset arrangement."""
-        b = arr.batch
-        n = int(b.count)
-        return {
-            "n": n,
-            "cols": [np.asarray(c)[:n] for c in b.cols],
-            "nulls": [
-                None if x is None else np.asarray(x)[:n]
-                for x in b.nulls
-            ],
-            "diff": np.asarray(b.diff)[:n],
-        }
-
     def peek_errors(self) -> list[tuple]:
         """The maintained err collection: [(err_code, count)] with
         count != 0. Nonempty means reads of this dataflow must raise
@@ -1959,13 +2058,11 @@ class ShardedDataflow(_DataflowBase):
             slot_cap=slot_cap, state_cap=state_cap,
         )
         self._run = _build(expr, ctx)
-        if ctx.basic_sites:
-            raise NotImplementedError(
-                "basic aggregates (string_agg/array_agg/list_agg) are "
-                "not yet supported on sharded dataflows: edge "
-                "finalization reads the single-device multiset state"
-            )
-        self._basic_finalizers = []
+        # Basic aggregates work sharded: the reduce input exchange keys
+        # every group to exactly one worker, so the per-worker multiset
+        # shards are group-disjoint and _basic_multiset_host's gather
+        # yields a group-contiguous multiset for edge finalization.
+        self._basic_finalizers = _resolve_basic_sites(expr, ctx)
         self._ctx = ctx
         self.input_shard_cap = input_shard_cap
         self._sharding = worker_sharding(mesh, self.axis_name)
@@ -2246,6 +2343,23 @@ class ShardedDataflow(_DataflowBase):
         """Host view of a per-worker output delta from step()."""
         return self._gather_batch(out)
 
+    def _basic_multiset_host(self, arr) -> dict:
+        """Host view of a SHARDED basic multiset: concatenate each
+        worker's valid rows. Groups are worker-disjoint (reduce's keyed
+        exchange), so the concatenation is group-contiguous — exactly
+        what the group-boundary scan in _basic_group_maps needs."""
+        b = self._gather_batch(arr.batch)
+        n = int(b.count)
+        return {
+            "n": n,
+            "cols": [np.asarray(c)[:n] for c in b.cols],
+            "nulls": [
+                None if x is None else np.asarray(x)[:n]
+                for x in b.nulls
+            ],
+            "diff": np.asarray(b.diff)[:n],
+        }
+
     def peek_errors(self) -> list[tuple]:
         """Gather every worker's err shard: [(err_code, count)]."""
         if not getattr(self, "_has_errors", False):
@@ -2259,7 +2373,27 @@ class ShardedDataflow(_DataflowBase):
         """Gather and combine every worker's output-arrangement shard.
         Different workers may hold the same row value (outputs stay where
         they were computed), so diffs are summed host-side."""
-        rows = self._gather_batch(self.output_batch()).to_rows()
+        b = self._gather_batch(self.output_batch())
+        if self._basic_finalizers:
+            n = int(b.count)
+            cols = [np.asarray(c)[:n] for c in b.cols]
+            nulls = [
+                None if x is None else np.asarray(x)[:n]
+                for x in b.nulls
+            ]
+            cols = self.finalize_basic_columns(cols, nulls)
+            cols = cols + [
+                np.asarray(b.time)[:n], np.asarray(b.diff)[:n]
+            ]
+            rows = [
+                tuple(
+                    x.item() if isinstance(x, np.generic) else x
+                    for x in row
+                )
+                for row in zip(*cols)
+            ]
+        else:
+            rows = b.to_rows()
         acc: dict = {}
         for r in rows:
             key = r[:-2]  # value columns only: shards may hold the same

@@ -256,6 +256,10 @@ class Statement:
 @dataclass(frozen=True)
 class SelectStatement(Statement):
     query: Query
+    # SELECT ... AS OF <time>: read at an explicit timestamp inside the
+    # multiversion window (reference: sql-parser AS OF on SELECT/
+    # SUBSCRIBE, adapter/src/coord/read_policy.rs lag windows)
+    as_of: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -354,6 +358,7 @@ class Explain(Statement):
 @dataclass(frozen=True)
 class Subscribe(Statement):
     query: Query
+    as_of: Optional[int] = None
 
 
 @dataclass(frozen=True)

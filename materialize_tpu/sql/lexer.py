@@ -35,7 +35,7 @@ KEYWORDS = {
     "show", "insert", "into", "values", "subscribe", "count", "sum",
     "min", "max", "avg", "coalesce", "interval", "extract", "year",
     "default", "return", "at", "recursion", "tpch", "auction", "counter",
-    "scale", "factor", "up", "to", "tick", "in", "columns",
+    "scale", "factor", "up", "to", "tick", "in", "columns", "of",
     "delete", "update", "set",
     "copy", "stdin", "stdout",
 }

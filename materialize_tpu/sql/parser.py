@@ -209,9 +209,11 @@ class Parser:
             ):
                 name = self.expect_ident()
                 return ast.Subscribe(
-                    Parser(f"SELECT * FROM {name}").parse_query()
+                    Parser(f"SELECT * FROM {name}").parse_query(),
+                    self._parse_as_of(),
                 )
-            return ast.Subscribe(self.parse_query())
+            q = self.parse_query()
+            return ast.Subscribe(q, self._parse_as_of())
         if self.accept_kw("show"):
             kind = self.expect_ident()
             if kind.lower() in (
@@ -220,7 +222,22 @@ class Parser:
             ):
                 return ast.ShowObjects(kind)
             return ast.ShowVar(kind)  # SHOW <system variable>
-        return ast.SelectStatement(self.parse_query())
+        q = self.parse_query()
+        return ast.SelectStatement(q, self._parse_as_of())
+
+    def _parse_as_of(self):
+        """Optional statement-level ``AS OF <int>`` (reference:
+        sql-parser AS OF on SELECT/SUBSCRIBE). Only legal AFTER a full
+        query — table-alias AS never reaches here."""
+        if not self.accept_kw("as"):
+            return None
+        self.expect_kw("of")
+        t = self.next()
+        if t.kind is not TokKind.NUMBER:
+            raise ParseError(
+                f"AS OF expects an integer timestamp at {t.pos}"
+            )
+        return int(t.text)
 
     # -- DDL ---------------------------------------------------------------
     def _create(self) -> ast.Statement:
@@ -601,6 +618,12 @@ class Parser:
         return ast.TableName(name, alias)
 
     def _table_alias(self) -> Optional[ast.TableAlias]:
+        # `AS OF <n>` after a table factor is the statement-level
+        # timestamp clause, never an alias named "of" (OF is reserved
+        # in alias position, as in the reference's parser).
+        if self.peek().is_kw("as") and self.peek(1).is_kw("of") \
+                and self.peek(2).kind is TokKind.NUMBER:
+            return None
         if self.accept_kw("as"):
             name = self.expect_ident()
         elif self.peek().kind is TokKind.IDENT:

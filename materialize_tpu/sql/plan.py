@@ -38,6 +38,9 @@ class SelectPlan(Plan):
     # COPY (query) TO STDOUT: stream the result over the COPY-out
     # subprotocol instead of DataRows
     copy_out: bool = False
+    # SELECT ... AS OF <t>: read at an explicit timestamp inside the
+    # multiversion window (read_policy.rs lag analog); None = latest
+    as_of: Optional[int] = None
 
 
 @dataclass
@@ -141,6 +144,7 @@ class ShowVarPlan(Plan):
 class SubscribePlan(Plan):
     expr: mir.RelationExpr
     column_names: tuple
+    as_of: Optional[int] = None
 
 
 @dataclass
@@ -173,6 +177,7 @@ def _plan(stmt: ast.Statement, catalog: CatalogInterface) -> Plan:
             tuple(it.name for it in scope.items),
             getattr(qp, "finishing_order", ()),
         )
+        plan.as_of = stmt.as_of
         # A top-level LIMIT ordered by text cannot run as a device TopK
         # (string ranks shift as the dictionary grows; ops/topk.py):
         # strip it and finish host-side with the peek's RowSetFinishing.
@@ -241,7 +246,9 @@ def _plan(stmt: ast.Statement, catalog: CatalogInterface) -> Plan:
     if isinstance(stmt, ast.Subscribe):
         hir_rel, scope = qp.plan_query(stmt.query)
         return SubscribePlan(
-            lower(hir_rel), tuple(it.name for it in scope.items)
+            lower(hir_rel),
+            tuple(it.name for it in scope.items),
+            stmt.as_of,
         )
     if isinstance(stmt, ast.Explain):
         return _explain(stmt, catalog)

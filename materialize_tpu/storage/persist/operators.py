@@ -29,6 +29,13 @@ class SinkConflict(RuntimeError):
     race): the view must be rebuilt from the durable shard."""
 
 
+class AsOfError(RuntimeError):
+    """AS OF timestamp outside the readable multiversion window
+    [since, upper). Deliberately NOT a ValueError: the replica's build
+    retry loop treats ValueError as a transient compaction race, and a
+    bad user timestamp must fail immediately."""
+
+
 def updates_to_batch(
     schema: Schema, cols, nulls, time, diff, as_of: int,
     capacity: int | None = None,
@@ -137,8 +144,10 @@ class IndexSource:
 
         def reload(self):
             s = self._src
+            # The readable floor is the PUBLISHER's multiversion since:
+            # snapshot() rewinds below base_upper-1 within the window.
             return IndexSource._State(
-                since=max(s.base_upper - 1, 0),
+                since=min(s.publisher.since, max(s.base_upper - 1, 0)),
                 upper=s.publisher.upper,
             )
 
@@ -251,10 +260,50 @@ class IndexSource:
 
     def snapshot(self, as_of: int) -> "tuple[Batch, int]":
         if as_of < self.base_upper - 1:
-            raise ValueError(
-                f"index import cannot rewind to {as_of}: publisher "
-                f"arrangement is at {self.base_upper - 1} (no "
-                "multiversion arrangements)"
+            # Multiversion rewind: the publisher retains a bounded
+            # window of output deltas (read-policy lag analog,
+            # adapter/src/coord/read_policy.rs); inside it, the base
+            # snapshot minus the deltas in (as_of, base_upper)
+            # reconstructs the arrangement at as_of.
+            pub = self.publisher
+            if as_of < pub.since:
+                raise AsOfError(
+                    f"index import cannot rewind to {as_of}: the "
+                    f"publisher's multiversion window is "
+                    f"[{pub.since}, {pub.upper})"
+                )
+            self.frontier = as_of + 1
+            parts = [
+                _host_updates(self.base_batch)
+                if self._device
+                else self.base
+            ]
+            # The rewound-past deltas must ALSO be queued for forward
+            # replay: they are folded into the base (not in _pending),
+            # and a subscriber stepping past as_of needs them back.
+            replay = []
+            for ht, upd in pub._history:
+                if as_of < ht <= self.base_upper - 1:
+                    cols, nulls, htime, diff = upd
+                    parts.append((cols, nulls, htime, np.negative(diff)))
+                    replay.append(
+                        (
+                            ht,
+                            updates_to_batch(
+                                self.schema, cols, nulls, htime,
+                                diff, ht,
+                            )
+                            if self._device
+                            else upd,
+                        )
+                    )
+            self._pending = replay + self._pending
+            cols, nulls, time, diff = self._concat(parts)
+            return (
+                updates_to_batch(
+                    self.schema, cols, nulls, time, diff, as_of
+                ),
+                as_of,
             )
         self.frontier = as_of + 1
         if self._device:
@@ -327,18 +376,32 @@ class MaintainedView:
         output_shard: str | None,
         index_sources: dict[str, "IndexSource"] | None = None,
         replica_id: str = "r0",
+        as_of: int | None = None,
     ):
         self.client = client
         self.replica_id = replica_id
         self.df = dataflow
-        if output_shard and getattr(dataflow, "_basic_finalizers", None):
-            # The sink would persist opaque digests; readers of the
-            # shard could never finalize them (the multiset lives on
-            # this replica's device). INDEX/SELECT serve these fine.
-            raise ValueError(
-                "string_agg/array_agg/list_agg cannot be persisted in "
-                "a MATERIALIZED VIEW yet; use a VIEW, INDEX, or SELECT"
-            )
+        # Multiversion window (read-policy lag analog,
+        # adapter/src/coord/read_policy.rs): retain the last N output
+        # deltas as host arrays so reads can rewind to any time in
+        # [since, upper). since advances as deltas are evicted.
+        from ...utils.dyncfg import COMPUTE_CONFIGS, COMPUTE_RETAIN_HISTORY
+
+        self._history: list = []  # [(t, (cols, nulls, time, diff))]
+        self.retain = int(COMPUTE_RETAIN_HISTORY(COMPUTE_CONFIGS))
+        self._since = 0
+        self._as_of_override = as_of
+        # MVs over basic aggregates persist MATERIALIZED VALUES: the
+        # sink path finalizes each output delta's digest columns into
+        # result strings (retractions resolve against the PRE-step
+        # multiset) and dictionary-encodes them, so shard parts carry
+        # real strings and readers never see a digest
+        # (render/reduce.rs:369 + the materialized-view sink analog).
+        self._sink_finalizes = bool(
+            output_shard
+            and getattr(dataflow, "_basic_finalizers", None)
+        )
+        self._pre_step_multisets = None
         self._subscribers: list = []
         self.sources = {
             name: ShardSource(client.open_reader(shard), schema)
@@ -371,6 +434,47 @@ class MaintainedView:
         input times < upper."""
         return self._upper
 
+    @property
+    def since(self) -> int:
+        """Earliest readable time: reads AS OF t are servable for
+        since <= t < upper (the multiversion window)."""
+        return self._since
+
+    def _record_history(self, t: int, out: Batch) -> None:
+        """Retain this step's output delta for the multiversion window;
+        evicting the oldest delta advances since (logical compaction of
+        the window, persist downgrade_since analog)."""
+        if self.retain <= 0:
+            self._since = t
+            return
+        self._history.append((t, _host_updates(out)))
+        while len(self._history) > self.retain:
+            evicted_t, _ = self._history.pop(0)
+            self._since = evicted_t
+
+    def updates_as_of(self, t: int):
+        """Host update arrays (cols, nulls, time, diff) of the
+        maintained result rewound to time ``t``: the current result
+        plus the NEGATION of every retained delta in (t, upper). Times
+        forward to t (logical compaction to the read time)."""
+        if getattr(self.df, "_basic_finalizers", None):
+            raise AsOfError(
+                "AS OF is not supported over basic aggregates "
+                "(string_agg/array_agg/list_agg): their digest "
+                "accumulators cannot be rewound"
+            )
+        if not (self._since <= t < self._upper):
+            raise AsOfError(
+                f"Timestamp ({t}) is not valid for all inputs: the "
+                f"readable window is [{self._since}, {self._upper})"
+            )
+        parts = [_host_updates(self.result_batch())]
+        for ht, (cols, nulls, htime, diff) in self._history:
+            if ht > t:
+                parts.append((cols, nulls, htime, np.negative(diff)))
+        cols, nulls, _time, diff = IndexSource._concat(parts)
+        return cols, nulls, np.full(len(diff), t, np.uint64), diff
+
     def expire(self) -> None:
         """Release this view's shard read holds (must be called when the
         view is dropped or replaced, or the holds pin compaction forever)."""
@@ -402,8 +506,21 @@ class MaintainedView:
             ]
             max_since = max((st.since for st in sts), default=0)
             min_upper = min((st.upper for st in sts), default=0)
-            as_of = max(max_since, min_upper - 1)
-            if as_of <= 0 and max_since == 0:
+            if self._as_of_override is not None:
+                # Explicit AS OF: hydrate at exactly t (as_of_selection
+                # honors a user AS OF). Validate against input sinces
+                # NOW — a too-old timestamp is a user error, not a
+                # transient race to retry.
+                as_of = self._as_of_override
+                if as_of < max_since:
+                    raise AsOfError(
+                        f"Timestamp ({as_of}) is not valid for all "
+                        f"inputs: less than the as-of frontier "
+                        f"{max_since}"
+                    )
+            else:
+                as_of = max(max_since, min_upper - 1)
+            if as_of <= 0 and max_since == 0 and self._as_of_override is None:
                 # Nothing (or only t=0) ingested and no compaction:
                 # replay from scratch, no snapshot step needed.
                 for s in self.sources.values():
@@ -427,6 +544,7 @@ class MaintainedView:
             out = self.result_batch()
             self._append(out, 0, as_of + 1, as_of)
             self._upper = as_of + 1
+            self._since = as_of  # the snapshot collapsed prior history
         else:
             as_of = out_upper - 1
             # Index imports cannot rewind: the publisher arrangement is
@@ -435,9 +553,12 @@ class MaintainedView:
             # correction chunk (desired snapshot ⊖ durable sink content)
             # covering the skipped interval — the reference's v2 sink
             # correction-buffer model (sink/correction_v2.rs).
+            # With publisher multiversion windows, an index import can
+            # rewind down to the publisher's since — only beyond that
+            # does the correction-chunk path engage.
             min_feasible = max(
                 (
-                    s.base_upper - 1
+                    s.publisher.since
                     for s in self.sources.values()
                     if isinstance(s, IndexSource)
                 ),
@@ -458,6 +579,7 @@ class MaintainedView:
                 inputs[name] = b
             self.df.time = corrected_as_of
             self.df.step(inputs)  # rebuild arrangements
+            self._since = corrected_as_of
             if corrected_as_of == as_of:
                 # output delta already durable — do NOT append.
                 self._upper = out_upper
@@ -493,6 +615,12 @@ class MaintainedView:
             return acc
 
         cols, nulls, _t, diff = _host_updates(self.result_batch())
+        if self._sink_finalizes:
+            # Compare in VALUE space: finalize digests (the current
+            # multiset matches result_batch exactly) and encode, so
+            # desired keys are the same dictionary codes the durable
+            # shard holds.
+            cols = self._finalize_sink_columns(list(cols), nulls, diff)
         desired = acc_multiset(cols, nulls, diff)
         # Reader id is stable PER REPLICA: distinct across active-active
         # siblings (a shared identity would let one replica's expire()
@@ -558,6 +686,10 @@ class MaintainedView:
         nulls = [
             None if nl is None else np.asarray(nl)[:n] for nl in batch.nulls
         ]
+        if self._sink_finalizes:
+            data_cols = self._finalize_sink_columns(
+                [np.asarray(c) for c in data_cols], nulls, diff
+            )
         for attempt in range(5):
             try:
                 self.writer.compare_and_append(
@@ -594,6 +726,28 @@ class MaintainedView:
             f"sink append [{lower},{upper}) kept losing writer fencing"
         )
 
+    def _finalize_sink_columns(self, data_cols, nulls, diff):
+        """Digest columns -> materialized result strings -> dictionary
+        codes, so the durable shard carries REAL values. Retraction
+        rows (diff < 0) finalize against the pre-step multiset capture
+        (their digests describe group states the post-step multiset no
+        longer holds)."""
+        from ...repr.schema import GLOBAL_DICT
+
+        fin = self.df.finalize_basic_columns(
+            data_cols, nulls, diffs=diff,
+            old_multisets=self._pre_step_multisets,
+        )
+        for out_col, *_rest in self.df._basic_finalizers:
+            fin[out_col] = np.asarray(
+                [
+                    0 if s is None else GLOBAL_DICT.encode(s)
+                    for s in fin[out_col]
+                ],
+                dtype=np.int64,
+            )
+        return fin
+
     # -- steady state ------------------------------------------------------
     def step(self, timeout: float = 5.0) -> bool:
         """Process all sources' updates up to a COMMON target frontier
@@ -606,11 +760,16 @@ class MaintainedView:
             # emits the constants, then the frontier is complete.
             if lower > 0:
                 return False
+            if self._sink_finalizes:
+                self._pre_step_multisets = (
+                    self.df.capture_basic_multisets()
+                )
             self.df.time = 0
             out = self.df.step({})
             out = self.df.gather_delta(out)
             self._append(out, 0, 1, 0)
             self._publish(0, out)
+            self._record_history(0, out)
             self._upper = 1
             return True
         target = None
@@ -629,11 +788,16 @@ class MaintainedView:
             name: s.fetch_to(target) for name, s in self.sources.items()
         }
         t = target - 1
+        if self._sink_finalizes:
+            self._pre_step_multisets = (
+                self.df.capture_basic_multisets()
+            )
         self.df.time = t
         out = self.df.step(polled)
         out = self.df.gather_delta(out)  # no-op on single-device
         self._append(out, lower, target, t)
         self._publish(t, out)
+        self._record_history(t, out)
         self._upper = target
         return True
 

@@ -145,9 +145,17 @@ def run_slt(text: str, coordinator, name: str = "<slt>") -> int:
                 raise SltError(
                     f"{where}: query failed: {e}\n  {rec.sql}"
                 ) from e
-            got = [
-                "  ".join(_fmt(v) for v in row) for row in res.rows
-            ]
+            if getattr(res, "text", None) is not None and not res.rows:
+                # EXPLAIN and other text results: one row per line
+                # (the reference's sqllogictest asserts EXPLAIN output
+                # the same way; indentation normalizes away below).
+                got = [
+                    l for l in res.text.split("\n") if l.strip()
+                ]
+            else:
+                got = [
+                    "  ".join(_fmt(v) for v in row) for row in res.rows
+                ]
             expected = list(rec.expected)
             if rec.sort == "rowsort":
                 got.sort()

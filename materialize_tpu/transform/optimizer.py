@@ -1189,9 +1189,278 @@ def projection_pushdown(expr: mir.RelationExpr) -> mir.RelationExpr:
     return _bottom_up(expr, rw)
 
 
+def _null_filtered(e: mir.RelationExpr, col: int) -> bool:
+    """True if the input spine already rejects NULLs in ``col`` (a
+    NOT(IS_NULL(col)) predicate at any level pushdown can have sunk it
+    to: Filter/Project/Map/Negate)."""
+    cur, c = e, col
+    while True:
+        if isinstance(cur, mir.Filter):
+            for p in cur.predicates:
+                if (
+                    isinstance(p, ms.CallUnary)
+                    and p.func == ms.UnaryFunc.NOT
+                    and isinstance(p.expr, ms.CallUnary)
+                    and p.expr.func == ms.UnaryFunc.IS_NULL
+                    and isinstance(p.expr.expr, ms.ColumnRef)
+                    and p.expr.expr.index == c
+                ):
+                    return True
+            cur = cur.input
+        elif isinstance(cur, mir.Project):
+            c = cur.outputs[c]
+            cur = cur.input
+        elif isinstance(cur, mir.Map):
+            if c >= cur.input.schema().arity:
+                return False  # a mapped scalar: stop
+            cur = cur.input
+        elif isinstance(cur, mir.Negate):
+            cur = cur.input
+        else:
+            return False
+
+
+def non_null_requirements(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """NonNullRequirements (transform/src/non_null_requirements.rs),
+    join form: join-key equality never matches NULL, so every nullable
+    column in a >=2-member equivalence class gets an IS NOT NULL filter
+    on its owning input — pruning NULL rows BEFORE they enter join
+    arrangements (smaller device state, fewer merge lanes). Run ONCE
+    ahead of the logical fixpoint; predicate pushdown then sinks the
+    filters toward sources, and _null_filtered keeps re-optimization
+    idempotent."""
+
+    def rw(e):
+        if not isinstance(e, mir.Join):
+            return e
+        offsets = [0]
+        for i in e.inputs:
+            offsets.append(offsets[-1] + i.schema().arity)
+        need: list = [set() for _ in e.inputs]
+        for cls in e.equivalences:
+            if len(cls) < 2:
+                continue
+            for s in cls:
+                if not isinstance(s, ms.ColumnRef):
+                    continue
+                for k in range(len(e.inputs)):
+                    if offsets[k] <= s.index < offsets[k + 1]:
+                        local = s.index - offsets[k]
+                        sch = e.inputs[k].schema()
+                        if sch[local].nullable and not _null_filtered(
+                            e.inputs[k], local
+                        ):
+                            need[k].add(local)
+                        break
+        if not any(need):
+            return e
+        new_inputs = []
+        for k, inp in enumerate(e.inputs):
+            if need[k]:
+                preds = tuple(
+                    ms.CallUnary(
+                        ms.UnaryFunc.NOT,
+                        ms.CallUnary(
+                            ms.UnaryFunc.IS_NULL, ms.ColumnRef(c)
+                        ),
+                    )
+                    for c in sorted(need[k])
+                )
+                inp = mir.Filter(inp, preds)
+            new_inputs.append(inp)
+        return mir.Join(
+            tuple(new_inputs), e.equivalences, e.implementation
+        )
+
+    return _bottom_up(expr, rw)
+
+
+def literal_lifting(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """LiteralLifting (transform/src/literal_lifting.rs), union form:
+    when every Union branch ends in a Map of the SAME literal scalars,
+    lift the Map above the Union — the union then moves narrower rows
+    (fewer device lanes) and the literals are computed once."""
+
+    def tail_literals(e):
+        if isinstance(e, mir.Map) and e.scalars and all(
+            isinstance(s, ms.Literal) for s in e.scalars
+        ):
+            return e.input, e.scalars
+        return None, None
+
+    def rw(e):
+        if not isinstance(e, mir.Union) or len(e.inputs) < 2:
+            return e
+        stripped, lits = [], None
+        for b in e.inputs:
+            inner, ls = tail_literals(b)
+            if inner is None:
+                return e
+            if lits is None:
+                lits = ls
+            elif ls != lits:
+                return e
+            stripped.append(inner)
+        return mir.Map(mir.Union(tuple(stripped)), lits)
+
+    return _bottom_up(expr, rw)
+
+
+def join_fusion(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """Join fusion (transform/src/fusion/join.rs): flatten a Join whose
+    input is itself a Join into one multiway Join. The splice preserves
+    the global column order (the inner join's columns occupy the same
+    contiguous range), so outer equivalences stay valid and inner ones
+    shift by the inner's global offset. Flattening is what lets
+    join_ordering and the delta-join planner see SQL's nested binary
+    join chains as the multiway joins they are."""
+
+    def rw(e):
+        if not isinstance(e, mir.Join) or e.implementation != "auto":
+            return e
+        new_inputs: list = []
+        extra_equivs: list = []
+        changed = False
+        offset = 0
+        for inp in e.inputs:
+            fused = False
+            if (
+                isinstance(inp, mir.Join)
+                and inp.implementation == "auto"
+            ):
+                shift = {
+                    r: r + offset for r in range(inp.schema().arity)
+                }
+                shifted_all: list = []
+                ok = True
+                for cls in inp.equivalences:
+                    shifted = tuple(
+                        _shift_scalar(s, shift) for s in cls
+                    )
+                    if any(s is None for s in shifted):
+                        # non-columnar member we cannot remap: keep
+                        # the nested join intact
+                        ok = False
+                        break
+                    shifted_all.append(shifted)
+                if ok:
+                    new_inputs.extend(inp.inputs)
+                    extra_equivs.extend(shifted_all)
+                    changed = fused = True
+            if not fused:
+                new_inputs.append(inp)
+            offset += inp.schema().arity
+        if not changed:
+            return e
+        return mir.Join(
+            tuple(new_inputs),
+            tuple(e.equivalences) + tuple(extra_equivs),
+            e.implementation,
+        )
+
+    return _bottom_up(expr, rw)
+
+
+def join_ordering(expr: mir.RelationExpr) -> mir.RelationExpr:
+    """JoinImplementation's input-ordering half
+    (transform/src/join_implementation.rs optimize_orders): permute
+    join inputs so the chain starts at the most filtered input and
+    every later input shares an equivalence with the already-joined
+    prefix (no accidental cross products), then restore the original
+    column order with an outer Project so parents are unaffected."""
+
+    def selectivity(e) -> int:
+        score, cur = 0, e
+        while True:
+            if isinstance(cur, mir.Filter):
+                score += len(cur.predicates)
+                cur = cur.input
+            elif isinstance(cur, (mir.Project, mir.Map)):
+                cur = cur.input
+            elif isinstance(cur, mir.Constant):
+                return score + 10  # known-tiny relation
+            else:
+                return score
+
+    def rw(e):
+        if (
+            not isinstance(e, mir.Join)
+            or e.implementation != "auto"
+            or len(e.inputs) < 3
+        ):
+            # Binary joins: order is decided by arrangement reuse at
+            # render time; only 3+ chains benefit from reordering.
+            return e
+        n = len(e.inputs)
+        offsets = [0]
+        for i in e.inputs:
+            offsets.append(offsets[-1] + i.schema().arity)
+
+        def input_of(r: int) -> int:
+            for k in range(n):
+                if offsets[k] <= r < offsets[k + 1]:
+                    return k
+            raise AssertionError(r)
+
+        cls_inputs = []
+        for cls in e.equivalences:
+            touched: set = set()
+            for s in cls:
+                refs: set = set()
+                _refs(s, refs)
+                touched |= {input_of(r) for r in refs}
+            cls_inputs.append(touched)
+        scores = [selectivity(i) for i in e.inputs]
+        order = [max(range(n), key=lambda k: (scores[k], -k))]
+        remaining = set(range(n)) - set(order)
+        while remaining:
+            connected = [
+                k
+                for k in remaining
+                if any(
+                    k in t and (t & set(order)) for t in cls_inputs
+                )
+            ]
+            pool = connected or sorted(remaining)
+            nxt = max(pool, key=lambda k: (scores[k], -k))
+            order.append(nxt)
+            remaining.discard(nxt)
+        if order == list(range(n)):
+            return e
+        new_offsets: dict = {}
+        pos = 0
+        for k in order:
+            new_offsets[k] = pos
+            pos += e.inputs[k].schema().arity
+        total = offsets[-1]
+        mapping = {
+            r: new_offsets[input_of(r)] + (r - offsets[input_of(r)])
+            for r in range(total)
+        }
+        new_equivs = []
+        for cls in e.equivalences:
+            shifted = tuple(
+                _shift_scalar(s, mapping) for s in cls
+            )
+            if any(s is None for s in shifted):
+                return e  # non-columnar member we cannot remap: bail
+            new_equivs.append(shifted)
+        permuted = mir.Join(
+            tuple(e.inputs[k] for k in order),
+            tuple(new_equivs),
+            e.implementation,
+        )
+        return mir.Project(
+            permuted, tuple(mapping[r] for r in range(total))
+        )
+
+    return _bottom_up(expr, rw)
+
+
 LOGICAL_TRANSFORMS = (
     plan_distinct_aggregates,
     fuse,
+    join_fusion,
     fold_constants,
     column_knowledge,
     predicate_pushdown,
@@ -1201,15 +1470,27 @@ LOGICAL_TRANSFORMS = (
     redundant_join,
     projection_pushdown,
     threshold_elision,
+    literal_lifting,
 )
-PHYSICAL_TRANSFORMS = (join_implementation,)
+# Join ordering runs before implementation selection (both halves of
+# the reference's JoinImplementation), then equivalences re-canonicalize
+# over the permuted column space.
+PHYSICAL_TRANSFORMS = (
+    join_ordering,
+    canonicalize_join_equivalences,
+    join_implementation,
+)
 
 
 def logical_optimizer(
     expr: mir.RelationExpr, max_iters: int = 10
 ) -> mir.RelationExpr:
     """Run the logical transform set to fixpoint (transform/src/lib.rs:752
-    analog; bounded like the reference's fuel limits)."""
+    analog; bounded like the reference's fuel limits).
+    NonNullRequirements runs once ahead of the loop (its added filters
+    are then pushed/fused by the fixpoint; _null_filtered keeps a
+    second optimize() over the same tree from re-adding them)."""
+    expr = non_null_requirements(expr)
     for _ in range(max_iters):
         before = expr
         for t in LOGICAL_TRANSFORMS:
@@ -1226,4 +1507,8 @@ def physical_optimizer(expr: mir.RelationExpr) -> mir.RelationExpr:
 
 
 def optimize(expr: mir.RelationExpr) -> mir.RelationExpr:
-    return physical_optimizer(logical_optimizer(expr))
+    """logical fixpoint -> relational CSE (shared subplans bound in
+    Lets, rendered once) -> physical decisions."""
+    from .cse import relation_cse
+
+    return physical_optimizer(relation_cse(logical_optimizer(expr)))

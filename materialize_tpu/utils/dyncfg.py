@@ -102,3 +102,10 @@ ARRANGEMENT_COMPACTION_BATCHES = Config(
     "arrangement_compaction_batches", 8,
     "shard spine length that triggers background compaction",
 ).register(COMPUTE_CONFIGS)
+
+COMPUTE_RETAIN_HISTORY = Config(
+    "compute_retain_history", 32,
+    "multiversion window: per-dataflow output-delta history retained "
+    "for AS OF reads, in virtual timestamps (the read-policy lag "
+    "analog, adapter/src/coord/read_policy.rs)",
+).register(COMPUTE_CONFIGS)

@@ -1,0 +1,185 @@
+"""AS OF / multiversion window (SURVEY.md §2 read policies; reference:
+adapter/src/coord/read_policy.rs lag windows, sql-parser AS OF on
+SELECT/SUBSCRIBE, compute-client/src/as_of_selection.rs honoring a user
+AS OF, persist since/read holds).
+
+The TPU-native design: arrangements stay fully compacted at the frontier
+(fixed-shape device state), and the multiversion window is a bounded
+host-side ring of recent output deltas per maintained dataflow — AS OF t
+reads rewind the maintained result by the retained deltas in (t, upper).
+"""
+
+import pytest
+
+
+@pytest.fixture
+def coord(tmp_path):
+    import socket
+    import threading
+
+    from materialize_tpu.coord.coordinator import Coordinator
+    from materialize_tpu.coord.protocol import PersistLocation
+    from materialize_tpu.coord.replica import serve_forever
+    from materialize_tpu.storage.persist import (
+        FileBlob,
+        PersistClient,
+        SqliteConsensus,
+    )
+
+    loc = PersistLocation(
+        str(tmp_path / "blob"), str(tmp_path / "consensus.db")
+    )
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    ready = threading.Event()
+    threading.Thread(
+        target=serve_forever, args=(port, loc, "r0", ready), daemon=True
+    ).start()
+    assert ready.wait(10)
+    c = Coordinator(
+        PersistClient(
+            FileBlob(loc.blob_root), SqliteConsensus(loc.consensus_path)
+        ),
+        tick_interval=None,
+    )
+    c.add_replica("r0", ("127.0.0.1", port))
+    yield c
+    c.shutdown()
+
+
+def _rows(res):
+    return sorted(r[0] for r in res.rows)
+
+
+def _read_ts(coord, table):
+    """The latest readable time of a table's shard (upper - 1)."""
+    return coord._table_writers[table].machine.reload().upper - 1
+
+
+class TestSlowPathAsOf:
+    """SELECT ... AS OF over a table: the transient dataflow hydrates
+    its input shards at exactly t (shard history is the window)."""
+
+    def test_historical_reads(self, coord):
+        coord.execute("CREATE TABLE t (a bigint NOT NULL)")
+        coord.execute("INSERT INTO t VALUES (1)")
+        t1 = _read_ts(coord, "t")
+        coord.execute("INSERT INTO t VALUES (2)")
+        t2 = _read_ts(coord, "t")
+        coord.execute("INSERT INTO t VALUES (3)")
+        t3 = _read_ts(coord, "t")
+        assert t1 < t2 < t3
+        assert _rows(coord.execute(f"SELECT a FROM t AS OF {t1}")) == [1]
+        assert _rows(coord.execute(f"SELECT a FROM t AS OF {t2}")) == [
+            1, 2,
+        ]
+        assert _rows(coord.execute(f"SELECT a FROM t AS OF {t3}")) == [
+            1, 2, 3,
+        ]
+        # Plain SELECT still serves the latest time.
+        assert _rows(coord.execute("SELECT a FROM t")) == [1, 2, 3]
+
+    def test_before_table_history_collapses(self, coord):
+        # A DELETE is visible at its time and rewindable before it.
+        coord.execute("CREATE TABLE t (a bigint NOT NULL)")
+        coord.execute("INSERT INTO t VALUES (1), (2)")
+        t1 = _read_ts(coord, "t")
+        coord.execute("DELETE FROM t WHERE a = 1")
+        assert _rows(coord.execute("SELECT a FROM t")) == [2]
+        assert _rows(coord.execute(f"SELECT a FROM t AS OF {t1}")) == [
+            1, 2,
+        ]
+
+
+class TestFastPathAsOf:
+    """SELECT ... AS OF over an indexed relation: the maintained
+    dataflow rewinds inside its multiversion window; outside it, a
+    window error (read_policy.rs: reads below since are rejected)."""
+
+    def test_window_rewind_and_error(self, coord):
+        # Shrink the window BEFORE the index dataflow is built (the
+        # view reads the knob at construction).
+        coord.update_config({"compute_retain_history": 2})
+        try:
+            coord.execute("CREATE TABLE t (a bigint NOT NULL)")
+            coord.execute("CREATE VIEW v AS SELECT a FROM t")
+            coord.execute("CREATE DEFAULT INDEX ON v")
+            times = []
+            for v in (10, 20, 30, 40):
+                coord.execute(f"INSERT INTO t VALUES ({v})")
+                times.append(_read_ts(coord, "t"))
+            # Let the index catch up to the last write.
+            assert _rows(coord.execute("SELECT a FROM v")) == [
+                10, 20, 30, 40,
+            ]
+            t1, t2, t3, t4 = times
+            assert _rows(
+                coord.execute(f"SELECT a FROM v AS OF {t4}")
+            ) == [10, 20, 30, 40]
+            assert _rows(
+                coord.execute(f"SELECT a FROM v AS OF {t3}")
+            ) == [10, 20, 30]
+            # retain=2: deltas for t3, t4 retained => since == t2.
+            assert _rows(
+                coord.execute(f"SELECT a FROM v AS OF {t2}")
+            ) == [10, 20]
+            with pytest.raises(Exception, match="not valid"):
+                coord.execute(f"SELECT a FROM v AS OF {t1}")
+        finally:
+            coord.update_config({"compute_retain_history": None})
+
+    def test_index_source_rewind(self, coord):
+        """A transient dataflow importing a live index (TraceManager
+        sharing) can hydrate BELOW the publisher's frontier within the
+        window: IndexSource.snapshot rewinds the shared arrangement."""
+        coord.execute("CREATE TABLE t (a bigint NOT NULL)")
+        coord.execute("CREATE VIEW v AS SELECT a FROM t")
+        coord.execute("CREATE DEFAULT INDEX ON v")
+        coord.execute("INSERT INTO t VALUES (1)")
+        t1 = _read_ts(coord, "t")
+        # Step the index past t1 so the import must rewind.
+        coord.execute("INSERT INTO t VALUES (2)")
+        coord.execute("INSERT INTO t VALUES (3)")
+        assert _rows(coord.execute("SELECT a FROM v")) == [1, 2, 3]
+        # Not a bare Get (a filter), so this is a transient dataflow
+        # whose input is the index import, hydrated AS OF t1.
+        got = coord.execute(f"SELECT a FROM v WHERE a > 0 AS OF {t1}")
+        assert _rows(got) == [1]
+
+
+class TestSubscribeAsOf:
+    def test_snapshot_then_deltas(self, coord):
+        coord.execute("CREATE TABLE t (a bigint NOT NULL)")
+        coord.execute("INSERT INTO t VALUES (1), (2)")
+        t1 = _read_ts(coord, "t")
+        res = coord.execute(f"SUBSCRIBE (SELECT a FROM t) AS OF {t1}")
+        sub = res.subscription
+        try:
+            got = sub.poll(timeout=30.0)
+            assert got is not None
+            events, upper = got
+            snap = sorted(
+                (r[0], r[-1]) for r in events if r[-2] == t1
+            )
+            assert snap == [(1, 1), (2, 1)]
+        finally:
+            sub.close()
+
+
+class TestAsOfParsing:
+    def test_alias_as_still_parses(self, coord):
+        coord.execute("CREATE TABLE t (a bigint NOT NULL)")
+        coord.execute("INSERT INTO t VALUES (7)")
+        got = coord.execute(
+            "SELECT x.a FROM (SELECT a FROM t) AS x"
+        )
+        assert _rows(got) == [7]
+
+    def test_as_of_requires_integer(self, coord):
+        # A non-integer AS OF operand is a parse error (either at the
+        # AS OF clause or as trailing junk after an `of` alias).
+        coord.execute("CREATE TABLE t (a bigint NOT NULL)")
+        with pytest.raises(Exception, match="timestamp|trailing"):
+            coord.execute("SELECT a FROM t AS OF banana")

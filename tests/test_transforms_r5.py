@@ -1,0 +1,225 @@
+"""Round-5 optimizer transforms: relational CSE + NormalizeLets,
+NonNullRequirements, LiteralLifting, join ordering (reference:
+transform/src/cse/relation_cse.rs, normalize_lets/mod.rs,
+non_null_requirements.rs, literal_lifting.rs,
+join_implementation.rs optimize_orders)."""
+
+from materialize_tpu.expr import relation as mir
+from materialize_tpu.expr import scalar as ms
+from materialize_tpu.repr.schema import Column, ColumnType, Schema
+from materialize_tpu.transform.cse import (
+    inline_lets,
+    normalize_lets,
+    relation_cse,
+)
+from materialize_tpu.transform.optimizer import (
+    join_ordering,
+    literal_lifting,
+    non_null_requirements,
+    optimize,
+)
+
+S2 = Schema(
+    (
+        Column("a", ColumnType.INT64, False),
+        Column("b", ColumnType.INT64, True),
+    )
+)
+S1 = Schema((Column("x", ColumnType.INT64, False),))
+
+
+def _sum_reduce(inp):
+    return mir.Reduce(
+        inp,
+        (0,),
+        (mir.AggregateExpr(mir.AggregateFunc.SUM_INT, ms.ColumnRef(1)),),
+    )
+
+
+class TestRelationCse:
+    def test_shared_subtree_bound_once(self):
+        red = _sum_reduce(mir.Get("t", S2))
+        j = mir.Join((red, red), ((ms.ColumnRef(0), ms.ColumnRef(2)),))
+        out = relation_cse(j)
+        assert isinstance(out, mir.Let)
+        assert isinstance(out.value, mir.Reduce)
+        join = out.body
+        assert isinstance(join, mir.Join)
+        assert all(
+            isinstance(i, mir.Get) and i.name == out.name
+            for i in join.inputs
+        )
+
+    def test_single_occurrence_unchanged(self):
+        red = _sum_reduce(mir.Get("t", S2))
+        f = mir.Filter(
+            red,
+            (
+                ms.CallBinary(
+                    ms.BinaryFunc.GT,
+                    ms.ColumnRef(1),
+                    ms.Literal(0, ColumnType.INT64),
+                ),
+            ),
+        )
+        assert relation_cse(f) == f
+
+    def test_nested_duplicates_collapse(self):
+        # outer dup contains inner dup: inner must not survive as a
+        # single-use binding (NormalizeLets inlines it).
+        red = _sum_reduce(mir.Get("t", S2))
+        proj = mir.Project(red, (0,))
+        u = mir.Union((proj, proj))
+        out = relation_cse(u)
+        assert isinstance(out, mir.Let)
+        # exactly ONE binding layer: Let(cse, Project(Reduce..), Union)
+        assert not isinstance(out.body, mir.Let)
+
+    def test_schema_preserved(self):
+        red = _sum_reduce(mir.Get("t", S2))
+        j = mir.Join((red, red), ((ms.ColumnRef(0), ms.ColumnRef(2)),))
+        assert relation_cse(j).schema() == j.schema()
+
+    def test_inline_then_normalize_roundtrip(self):
+        red = _sum_reduce(mir.Get("t", S2))
+        bound = mir.Let(
+            "v",
+            red,
+            mir.Join(
+                (mir.Get("v", red.schema()), mir.Get("v", red.schema())),
+                ((ms.ColumnRef(0), ms.ColumnRef(2)),),
+            ),
+        )
+        flat = inline_lets(bound)
+        assert isinstance(flat, mir.Join)
+        rebound = relation_cse(flat)
+        assert isinstance(rebound, mir.Let)
+
+    def test_normalize_drops_unused(self):
+        e = mir.Let("dead", mir.Get("t", S2), mir.Get("u", S2))
+        assert normalize_lets(e) == mir.Get("u", S2)
+
+
+class TestNonNullRequirements:
+    def test_nullable_join_key_filtered(self):
+        # b (nullable) joins a (non-null): only b's side gets a filter.
+        j = mir.Join(
+            (mir.Get("t", S2), mir.Get("u", S2)),
+            ((ms.ColumnRef(1), ms.ColumnRef(2)),),
+        )
+        out = non_null_requirements(j)
+        assert isinstance(out, mir.Join)
+        lhs, rhs = out.inputs
+        assert isinstance(lhs, mir.Filter)  # col 1 nullable
+        assert isinstance(rhs, mir.Get)  # col 0 of u non-nullable
+
+    def test_idempotent(self):
+        j = mir.Join(
+            (mir.Get("t", S2), mir.Get("u", S2)),
+            ((ms.ColumnRef(1), ms.ColumnRef(2)),),
+        )
+        once = non_null_requirements(j)
+        assert non_null_requirements(once) == once
+
+
+class TestLiteralLifting:
+    def test_union_of_identical_literal_maps(self):
+        lit = (ms.Literal(7, ColumnType.INT64),)
+        u = mir.Union(
+            (
+                mir.Map(mir.Get("t", S1), lit),
+                mir.Map(mir.Get("u", S1), lit),
+            )
+        )
+        out = literal_lifting(u)
+        assert isinstance(out, mir.Map)
+        assert isinstance(out.input, mir.Union)
+
+    def test_differing_literals_kept(self):
+        u = mir.Union(
+            (
+                mir.Map(
+                    mir.Get("t", S1), (ms.Literal(7, ColumnType.INT64),)
+                ),
+                mir.Map(
+                    mir.Get("u", S1), (ms.Literal(8, ColumnType.INT64),)
+                ),
+            )
+        )
+        assert literal_lifting(u) == u
+
+
+class TestJoinOrdering:
+    def _three_way(self):
+        t1, t2 = mir.Get("t1", S1), mir.Get("t2", S1)
+        f3 = mir.Filter(
+            mir.Get("t3", S1),
+            (
+                ms.CallBinary(
+                    ms.BinaryFunc.EQ,
+                    ms.ColumnRef(0),
+                    ms.Literal(5, ColumnType.INT64),
+                ),
+            ),
+        )
+        return mir.Join(
+            (t1, t2, f3),
+            ((ms.ColumnRef(0), ms.ColumnRef(1), ms.ColumnRef(2)),),
+        )
+
+    def test_filtered_input_leads(self):
+        out = join_ordering(self._three_way())
+        assert isinstance(out, mir.Project)
+        j = out.input
+        assert isinstance(j.inputs[0], mir.Filter)
+        # original column order restored for parents
+        assert out.outputs == (1, 2, 0)
+
+    def test_stable_under_reapplication(self):
+        out = join_ordering(self._three_way())
+
+        def again(e):
+            if isinstance(e, mir.Project):
+                inner = join_ordering(e.input)
+                return inner
+            return join_ordering(e)
+
+        # the permuted join is already in best order: unchanged
+        j2 = again(out)
+        assert j2 == out.input
+
+    def test_binary_join_untouched(self):
+        j = mir.Join(
+            (mir.Get("t1", S1), mir.Get("t2", S1)),
+            ((ms.ColumnRef(0), ms.ColumnRef(1)),),
+        )
+        assert join_ordering(j) == j
+
+
+class TestEndToEndOptimize:
+    def test_cse_in_full_pipeline(self):
+        red = _sum_reduce(mir.Get("t", S2))
+        j = mir.Join((red, red), ((ms.ColumnRef(0), ms.ColumnRef(2)),))
+        out = optimize(j)
+        assert isinstance(out, mir.Let)
+
+    def test_ordering_in_full_pipeline(self):
+        t1, t2 = mir.Get("t1", S1), mir.Get("t2", S1)
+        f3 = mir.Filter(
+            mir.Get("t3", S1),
+            (
+                ms.CallBinary(
+                    ms.BinaryFunc.EQ,
+                    ms.ColumnRef(0),
+                    ms.Literal(5, ColumnType.INT64),
+                ),
+            ),
+        )
+        j3 = mir.Join(
+            (t1, t2, f3),
+            ((ms.ColumnRef(0), ms.ColumnRef(1), ms.ColumnRef(2)),),
+        )
+        out = optimize(j3)
+        assert isinstance(out, mir.Project)
+        assert isinstance(out.input, mir.Join)
+        assert out.input.implementation == "delta"
