@@ -1,0 +1,84 @@
+"""Static analysis over MIR/LIR plans and rendered jaxprs.
+
+Analog of the reference's ``transform/src/typecheck.rs`` (the typecheck
+pass run between optimizer transforms) plus the physical-monotonicity
+interpreter (``compute-types/src/plan/interpret``), extended with a
+TPU-specific layer the reference has no analog for: a linter over the
+jitted step function's ClosedJaxpr that flags device hazards (float64
+leaks, host callbacks on the hot path, recompile hazards) before they
+cost a device crash or a silent 100x slowdown.
+
+Three passes:
+
+- ``typecheck``: bottom-up MIR validation (schema flow, column-ref
+  bounds, binding discipline, plan-decision consistency). Wired between
+  optimizer transforms behind the ``optimizer_typecheck`` dyncfg so a
+  transform bug is blamed on the transform that introduced it.
+- ``monotonic``: an abstract-interpretation lattice over MIR answering
+  "can this collection carry negative diffs" (nonneg) and "is it
+  append-only" — consumed by threshold elision and reduce/topk planning.
+- ``jaxpr_lint``: walks a rendered step function's jaxpr for TPU
+  hazards; surfaced via scripts/check_plans.py and the test suite.
+
+See doc/analysis.md for the catalogue of invariants and lints.
+"""
+
+from .jaxpr_lint import (  # noqa: F401
+    LintFinding,
+    lint_dataflow,
+    lint_jaxpr,
+    lint_step_fn,
+)
+from .monotonic import (  # noqa: F401
+    BOTTOM,
+    SOURCE_DEFAULT,
+    TOP,
+    Facts,
+    analyze,
+)
+from .typecheck import (  # noqa: F401
+    TransformTypecheckError,
+    TypecheckError,
+    typecheck,
+    typecheck_lir,
+)
+
+
+def report(expr, source_monotonic=frozenset()) -> str:
+    """Text summary of every analysis over one MIR plan (the EXPLAIN
+    ANALYSIS payload): typecheck verdict, monotonicity facts of the
+    output collection, and LIR plan-decision consistency."""
+    lines = []
+    try:
+        sch = typecheck(expr)
+        lines.append(
+            "typecheck: ok "
+            f"(arity={sch.arity}, "
+            f"types=[{', '.join(c.ctype.value for c in sch.columns)}])"
+        )
+    except TypecheckError as e:
+        # A plan that fails typecheck is exactly what this surface
+        # exists to diagnose — but the downstream passes assume a
+        # well-typed tree (analyze/typecheck_lir call schema() and
+        # index into children unguarded), so running them would trade
+        # the verdict for an arbitrary IndexError/KeyError.
+        lines.append(f"typecheck: FAILED: {e}")
+        lines.append("monotonicity: skipped (plan does not typecheck)")
+        lines.append("lir: skipped (plan does not typecheck)")
+        return "\n".join(lines)
+    facts = analyze(
+        expr,
+        source_facts={
+            n: TOP for n in source_monotonic
+        },
+    )
+    lines.append(
+        f"monotonicity: nonneg={str(facts.nonneg).lower()} "
+        f"append_only={str(facts.append_only).lower()}"
+    )
+    try:
+        typecheck_lir(expr)
+        lines.append("lir: ok")
+    except TypecheckError as e:
+        lines.append(f"lir: FAILED: {e}")
+    return "\n".join(lines)

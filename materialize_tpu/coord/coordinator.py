@@ -737,6 +737,7 @@ class Coordinator:
                 as_of=as_of,
             ),
             unlocked=unlocked,
+            durable=False,
         )
         try:
             if as_of is not None:
@@ -1299,8 +1300,27 @@ class Coordinator:
         )
 
     def _register_dataflow(
-        self, desc: DataflowDescription, unlocked: bool = True
+        self, desc: DataflowDescription, unlocked: bool = True,
+        durable: bool = True,
     ) -> None:
+        # Last line of defense before a DURABLE plan ships to replicas:
+        # the MIR/LIR typechecker (analysis/typecheck.py). Catching an
+        # invalid plan here costs a DDL error; catching it replica-side
+        # costs a render failure inside wait_installed with a worse
+        # message. Transient peeks (durable=False) skip it — the check
+        # would sit on every slow-path SELECT's latency, and a broken
+        # transient plan fails the one peek, not a persisted object.
+        # Also skipped when the optimizer_typecheck dyncfg is on: every
+        # call site passes optimize() output straight here, and under
+        # the flag the optimizer already typechecked after each
+        # transform (naming the offender) and ran typecheck_lir.
+        from ..utils.dyncfg import COMPUTE_CONFIGS, OPTIMIZER_TYPECHECK
+
+        if durable and not OPTIMIZER_TYPECHECK(COMPUTE_CONFIGS):
+            from ..analysis import typecheck, typecheck_lir
+
+            typecheck(desc.expr)
+            typecheck_lir(desc.expr)
         # Transitive upstream shards: index imports contribute their
         # PUBLISHER's upstream so timestamp selection for reads over
         # shared arrangements still sees the real persist inputs.

@@ -354,7 +354,40 @@ class Union(RelationExpr):
     inputs: tuple
 
     def schema(self):
-        return self.inputs[0].schema()
+        # Names/ctypes/scales come from branch 0; NULLABILITY is the
+        # least upper bound across branches. Outer-join and
+        # scalar-subquery lowerings build unions whose NULL-padding
+        # branch is nullable while branch 0 is not — deriving the
+        # schema from branch 0 alone claimed non-nullable columns that
+        # carry NULLs, which let column_knowledge fold IS_NULL(col) to
+        # false unsoundly (found by analysis/typecheck.py T-SCHEMA
+        # over the SLT corpus). Memoized: the lub walks EVERY branch,
+        # and lowerings nest union towers whose repeated schema() calls
+        # would otherwise be quadratic in the tower depth. The node is
+        # frozen/immutable, so the cache can never go stale.
+        memo = self.__dict__.get("_schema_memo")
+        if memo is not None:
+            return memo
+        base = self.inputs[0].schema()
+        cols = list(base.columns)
+        for inp in self.inputs[1:]:
+            for i, c in enumerate(inp.schema().columns):
+                if i < len(cols) and c.nullable and not cols[i].nullable:
+                    old = cols[i]
+                    cols[i] = Column(old.name, old.ctype, True, old.scale)
+        sch = Schema(tuple(cols))
+        object.__setattr__(self, "_schema_memo", sch)
+        return sch
+
+    def __getstate__(self):
+        # The memo must not leak into pickled state:
+        # DataflowDescription.fingerprint() pickles the expr, and
+        # replica reconciliation compares fingerprints byte-for-byte —
+        # a cache populated on one side but not the other would make an
+        # unchanged dataflow look changed and trigger a full rebuild.
+        d = dict(self.__dict__)
+        d.pop("_schema_memo", None)
+        return d
 
     def children(self):
         return list(self.inputs)

@@ -147,33 +147,16 @@ def plan_threshold(expr: mir.Threshold) -> ThresholdPlan:
 
 
 def monotonic(expr: mir.RelationExpr, source_monotonic=frozenset()):
-    """Bottom-up: can this collection ever retract? Sources are
-    append-only iff named in `source_monotonic` (the controller knows;
-    e.g. load generators in insert-only mode)."""
-    if isinstance(expr, mir.Get):
-        return expr.name in source_monotonic
-    if isinstance(expr, mir.Constant):
-        return all(d >= 0 for _, d in expr.rows)
-    if isinstance(expr, (mir.Project, mir.Map, mir.Filter, mir.FlatMap,
-                         mir.ArrangeBy)):
-        return monotonic(expr.input, source_monotonic)
-    if isinstance(expr, mir.Join):
-        return all(monotonic(i, source_monotonic) for i in expr.inputs)
-    if isinstance(expr, mir.Union):
-        return all(monotonic(i, source_monotonic) for i in expr.inputs)
-    if isinstance(expr, (mir.Reduce, mir.TopK)):
-        # outputs retract when groups change, even over monotonic input
-        return False
-    if isinstance(expr, (mir.Negate, mir.Threshold)):
-        return False
-    if isinstance(expr, mir.Let):
-        # conservative: body monotonicity with the binding treated as
-        # non-monotonic unless its value is
-        if monotonic(expr.value, source_monotonic):
-            return monotonic(
-                expr.body, source_monotonic | {expr.name}
-            )
-        return monotonic(expr.body, source_monotonic)
-    if isinstance(expr, mir.LetRec):
-        return False
-    return False
+    """Can this collection ever retract? Delegates to the monotonicity
+    lattice (analysis/monotonic.py), which threads facts through
+    Let/LetRec bindings via an environment. Sources are append-only iff
+    named in `source_monotonic` (the controller knows; e.g. load
+    generators in insert-only mode); every source is assumed
+    non-negative either way."""
+    from ..analysis.monotonic import SOURCE_DEFAULT, TOP, analyze
+
+    return analyze(
+        expr,
+        source_facts={n: TOP for n in source_monotonic},
+        default_source=SOURCE_DEFAULT,
+    ).append_only

@@ -351,7 +351,7 @@ class DropObject(Statement):
 
 @dataclass(frozen=True)
 class Explain(Statement):
-    stage: str  # raw/decorrelated/optimized/physical
+    stage: str  # raw/decorrelated/optimized/physical/analysis
     statement: Statement
 
 

@@ -32,6 +32,7 @@ KEYWORDS = {
     "create", "drop", "view", "materialized", "index", "source", "sink",
     "table", "cluster", "load", "generator", "for", "if", "replace",
     "explain", "plan", "raw", "decorrelated", "optimized", "physical",
+    "analysis",
     "show", "insert", "into", "values", "subscribe", "count", "sum",
     "min", "max", "avg", "coalesce", "interval", "extract", "year",
     "default", "return", "at", "recursion", "tpch", "auction", "counter",

@@ -418,7 +418,9 @@ class Parser:
         return ast.DropObject(kind, name, if_exists)
 
     def _explain(self):
-        stage = self.accept_kw("raw", "decorrelated", "optimized", "physical")
+        stage = self.accept_kw(
+            "raw", "decorrelated", "optimized", "physical", "analysis"
+        )
         if stage is None:
             stage = "optimized"
         self.accept_kw("plan")

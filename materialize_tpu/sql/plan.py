@@ -406,10 +406,17 @@ def _explain(stmt: ast.Explain, catalog: CatalogInterface) -> Plan:
     if stmt.stage == "decorrelated":
         return ExplainPlan("decorrelated", explain_mir(lower(hir_rel)))
     m = lower(hir_rel)
-    if stmt.stage in ("optimized", "physical"):
+    if stmt.stage in ("optimized", "physical", "analysis"):
         from ..transform.optimizer import optimize
 
         m = optimize(m)
+    if stmt.stage == "analysis":
+        # Static-analysis verdicts over the optimized plan: typecheck,
+        # monotonicity facts, LIR plan-decision consistency
+        # (materialize_tpu/analysis — doc/analysis.md catalogue).
+        from ..analysis import report
+
+        return ExplainPlan("analysis", report(m))
     if stmt.stage == "physical":
         # LIR: the operator-level physical plans (ReducePlan/TopKPlan/
         # JoinPlan) actually chosen by the render layer — lowered by the

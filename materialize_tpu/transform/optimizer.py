@@ -520,23 +520,41 @@ def column_knowledge(expr: mir.RelationExpr) -> mir.RelationExpr:
 
 def threshold_elision(expr: mir.RelationExpr) -> mir.RelationExpr:
     """Remove Threshold over inputs that cannot go negative
-    (transform/src/threshold_elision.rs): anything without Negate below."""
+    (transform/src/threshold_elision.rs), using the monotonicity
+    lattice (analysis/monotonic.py). Facts flow through Let/LetRec via
+    an environment, so a ``Get`` of a binding whose value contains a
+    ``Negate`` is correctly NOT assumed non-negative (the unsoundness
+    the previous ad-hoc closure had; regression in
+    tests/test_analysis_typecheck.py)."""
+    from ..analysis.monotonic import analyze
 
-    def nonneg(e) -> bool:
-        if isinstance(e, (mir.Negate,)):
-            return False
-        if isinstance(e, mir.Constant):
-            return all(d >= 0 for _, d in e.rows)
-        if isinstance(e, (mir.Get,)):
-            return True  # sources/lets: assumed nonnegative collections
-        return all(nonneg(c) for c in e.children())
+    def go(e, env):
+        if isinstance(e, mir.Threshold):
+            inner = go(e.input, env)
+            if analyze(inner, env=env).nonneg:
+                return inner
+            return mir.Threshold(inner)
+        if isinstance(e, mir.Let):
+            value = go(e.value, env)
+            env2 = dict(env)
+            env2[e.name] = analyze(value, env=env)
+            return mir.Let(e.name, value, go(e.body, env2))
+        if isinstance(e, mir.LetRec):
+            from ..analysis.monotonic import BOTTOM
 
-    def rw(e):
-        if isinstance(e, mir.Threshold) and nonneg(e.input):
-            return e.input
-        return e
+            env2 = dict(env)
+            for n in e.names:
+                env2[n] = BOTTOM
+            return mir.LetRec(
+                e.names,
+                tuple(go(v, env2) for v in e.values),
+                e.value_schemas,
+                go(e.body, env2),
+                e.max_iters,
+            )
+        return _children_replaced(e, lambda c: go(c, env))
 
-    return _bottom_up(expr, rw)
+    return go(expr, {})
 
 
 def join_implementation(expr: mir.RelationExpr) -> mir.RelationExpr:
@@ -1482,6 +1500,36 @@ PHYSICAL_TRANSFORMS = (
 )
 
 
+def _typecheck_enabled() -> bool:
+    from ..utils.dyncfg import COMPUTE_CONFIGS, OPTIMIZER_TYPECHECK
+
+    return bool(OPTIMIZER_TYPECHECK(COMPUTE_CONFIGS))
+
+
+def _run_checked(expr: mir.RelationExpr, transform) -> mir.RelationExpr:
+    """Apply one transform with the typechecker as a safety net
+    (transform/src/typecheck.rs discipline): the rewritten plan must
+    typecheck AND preserve the relation type, and a violation names the
+    transform that introduced it — blame attribution, not just
+    detection."""
+    from ..analysis.typecheck import (
+        TransformTypecheckError,
+        TypecheckError,
+        check_type_preserved,
+        typecheck,
+    )
+
+    before_schema = expr.schema()
+    out = transform(expr)
+    name = getattr(transform, "__name__", str(transform))
+    try:
+        typecheck(out)
+    except TypecheckError as e:
+        raise TransformTypecheckError(name, e) from e
+    check_type_preserved(before_schema, out.schema(), name)
+    return out
+
+
 def logical_optimizer(
     expr: mir.RelationExpr, max_iters: int = 10
 ) -> mir.RelationExpr:
@@ -1489,20 +1537,38 @@ def logical_optimizer(
     analog; bounded like the reference's fuel limits).
     NonNullRequirements runs once ahead of the loop (its added filters
     are then pushed/fused by the fixpoint; _null_filtered keeps a
-    second optimize() over the same tree from re-adding them)."""
-    expr = non_null_requirements(expr)
+    second optimize() over the same tree from re-adding them).
+
+    Under the ``optimizer_typecheck`` dyncfg every transform's output
+    is typechecked; an invalid plan raises TransformTypecheckError
+    naming the offending transform."""
+    check = _typecheck_enabled()
+    if check:
+        from ..analysis.typecheck import typecheck
+
+        typecheck(expr)  # pre-existing damage is not a transform's fault
+        expr = _run_checked(expr, non_null_requirements)
+    else:
+        expr = non_null_requirements(expr)
     for _ in range(max_iters):
         before = expr
         for t in LOGICAL_TRANSFORMS:
-            expr = t(expr)
+            expr = _run_checked(expr, t) if check else t(expr)
         if expr == before:
             break
     return expr
 
 
 def physical_optimizer(expr: mir.RelationExpr) -> mir.RelationExpr:
+    check = _typecheck_enabled()
     for t in PHYSICAL_TRANSFORMS:
-        expr = t(expr)
+        expr = _run_checked(expr, t) if check else t(expr)
+    if check:
+        # The physical plan is what renders: the LIR decisions must
+        # also be takeable (plan/decisions.py consistency, T-LIR).
+        from ..analysis.typecheck import typecheck_lir
+
+        typecheck_lir(expr)
     return expr
 
 
@@ -1511,4 +1577,10 @@ def optimize(expr: mir.RelationExpr) -> mir.RelationExpr:
     Lets, rendered once) -> physical decisions."""
     from .cse import relation_cse
 
-    return physical_optimizer(relation_cse(logical_optimizer(expr)))
+    expr = logical_optimizer(expr)
+    expr = (
+        _run_checked(expr, relation_cse)
+        if _typecheck_enabled()
+        else relation_cse(expr)
+    )
+    return physical_optimizer(expr)

@@ -103,6 +103,14 @@ ARRANGEMENT_COMPACTION_BATCHES = Config(
     "shard spine length that triggers background compaction",
 ).register(COMPUTE_CONFIGS)
 
+OPTIMIZER_TYPECHECK = Config(
+    "optimizer_typecheck", False,
+    "run the MIR typechecker (analysis/typecheck.py) between optimizer "
+    "transforms so an invalid plan is blamed on the transform that "
+    "produced it (transform/src/typecheck.rs analog); default-on in "
+    "the test suite via tests/conftest.py",
+).register(COMPUTE_CONFIGS)
+
 COMPUTE_RETAIN_HISTORY = Config(
     "compute_retain_history", 32,
     "multiversion window: per-dataflow output-delta history retained "

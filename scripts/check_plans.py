@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Static plan checking over the SLT corpus + jaxpr-lint of the bench
+dataflows.
+
+Two modes:
+
+  python scripts/check_plans.py [slt files...]
+      Parse every statement in tests/slt/*.slt (default) or the given
+      files, maintain a planning catalog, and for every planned
+      relation expression run the full static pipeline:
+      parse -> plan -> typecheck(raw) -> optimize (with the
+      per-transform typechecker on) -> typecheck_lir -> monotonicity.
+      Exit non-zero on any violation, naming file:line and the failing
+      stage. No dataflow is rendered and nothing compiles — this is
+      the fast CI lane for "every plan the corpus can produce survives
+      the analysis subsystem".
+
+  python scripts/check_plans.py --bench
+      Render the standard bench dataflows (TPCH Q1/Q15, the
+      BASELINE.json gate configs that run on every accelerator) and
+      walk their step programs' jaxprs with the TPU-hazard linter
+      (analysis/jaxpr_lint.py). Exit non-zero on any finding.
+
+Both modes are pure host work and run on CPU (`JAX_PLATFORMS=cpu`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _iter_plan_exprs(plan):
+    """(kind, expr) pairs carried by one statement Plan."""
+    from materialize_tpu.sql.plan import (
+        CreateViewPlan,
+        DeletePlan,
+        SelectPlan,
+        SubscribePlan,
+        UpdatePlan,
+    )
+
+    if isinstance(plan, SelectPlan):
+        yield "select", plan.expr
+    elif isinstance(plan, CreateViewPlan):
+        yield "view", plan.expr
+    elif isinstance(plan, SubscribePlan):
+        yield "subscribe", plan.expr
+    elif isinstance(plan, DeletePlan):
+        yield "delete", plan.expr
+    elif isinstance(plan, UpdatePlan):
+        for name in ("expr", "selection", "read"):
+            e = getattr(plan, name, None)
+            if e is not None:
+                yield "update", e
+                break
+
+
+def _apply_catalog(plan, catalog) -> None:
+    """Mirror the coordinator's catalog bookkeeping for the statement
+    kinds the SLT corpus uses (tables, views, indexes, drops)."""
+    from materialize_tpu.sql.catalog import CatalogItem
+    from materialize_tpu.sql.plan import (
+        CreateIndexPlan,
+        CreateTablePlan,
+        CreateViewPlan,
+        DropPlan,
+    )
+
+    if isinstance(plan, CreateTablePlan):
+        catalog.create(
+            CatalogItem(plan.name, "table", plan.schema),
+            or_replace=True,
+        )
+    elif isinstance(plan, CreateViewPlan):
+        schema = plan.expr.schema()
+        if plan.column_names and len(plan.column_names) == schema.arity:
+            schema = schema.rename(plan.column_names)
+        catalog.create(
+            CatalogItem(
+                plan.name,
+                "materialized-view" if plan.materialized else "view",
+                schema,
+                definition=plan.expr,
+                column_names=plan.column_names,
+            ),
+            or_replace=True,
+        )
+    elif isinstance(plan, DropPlan):
+        catalog.drop(plan.name, if_exists=True)
+    elif isinstance(plan, CreateIndexPlan):
+        pass  # indexes add no schema
+
+
+def check_slt_file(path: str, verbose: bool = False) -> list[str]:
+    """Run the static pipeline over one SLT file; returns violation
+    descriptions (empty = clean)."""
+    from materialize_tpu.analysis import analyze, typecheck, typecheck_lir
+    from materialize_tpu.sql.catalog import Catalog
+    from materialize_tpu.sql.hir import PlanError
+    from materialize_tpu.sql.parser import ParseError
+    from materialize_tpu.sql.plan import plan_statement
+    from materialize_tpu.testing.slt import parse_slt
+    from materialize_tpu.transform.optimizer import optimize
+
+    with open(path) as f:
+        records = parse_slt(f.read())
+
+    catalog = Catalog()
+    violations: list[str] = []
+    n_checked = 0
+    for rec in records:
+        if rec.kind == "statement_error":
+            continue  # meant to fail; nothing to check
+        where = f"{path}:{rec.line}"
+        try:
+            plan = plan_statement(rec.sql, catalog)
+        except (PlanError, ParseError):
+            # The live harness (tests/test_slt.py) is the authority on
+            # whether statements execute; here only plannable relation
+            # expressions are in scope.
+            continue
+        for kind, expr in _iter_plan_exprs(plan):
+            n_checked += 1
+            stage = "typecheck(raw)"
+            try:
+                typecheck(expr)
+                stage = "optimize+typecheck"
+                opt = optimize(expr)
+                stage = "typecheck(optimized)"
+                typecheck(opt)
+                stage = "typecheck_lir"
+                typecheck_lir(opt)
+                stage = "monotonicity"
+                analyze(opt)
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                violations.append(
+                    f"{where} [{kind}] failed at {stage}: {e}\n"
+                    f"    {rec.sql.strip().splitlines()[0]}"
+                )
+        _apply_catalog(plan, catalog)
+    if verbose:
+        print(
+            f"  {os.path.basename(path)}: {n_checked} plan(s) checked,"
+            f" {len(violations)} violation(s)"
+        )
+    return violations
+
+
+def run_slt_mode(paths: list[str], verbose: bool) -> int:
+    from materialize_tpu.utils.dyncfg import COMPUTE_CONFIGS
+
+    # Per-transform blame attribution for the whole sweep.
+    COMPUTE_CONFIGS.update({"optimizer_typecheck": True})
+    all_violations: list[str] = []
+    for path in paths:
+        all_violations.extend(check_slt_file(path, verbose))
+    if all_violations:
+        print(f"{len(all_violations)} violation(s):")
+        for v in all_violations:
+            print(f"  {v}")
+        return 1
+    print(f"OK: {len(paths)} SLT file(s) clean")
+    return 0
+
+
+def run_bench_mode(verbose: bool) -> int:
+    """Jaxpr-lint the standard bench dataflows (abstract tracing only —
+    nothing compiles)."""
+    from materialize_tpu.analysis import lint_dataflow
+    from materialize_tpu.render.dataflow import Dataflow
+    from materialize_tpu.transform.optimizer import optimize
+    from materialize_tpu.utils.dyncfg import COMPUTE_CONFIGS
+    from materialize_tpu.workloads.tpch import q1_mir, q15_mir
+
+    COMPUTE_CONFIGS.update({"optimizer_typecheck": True})
+    rc = 0
+    for name, mk in (("q1", q1_mir), ("q15", q15_mir)):
+        df = Dataflow(optimize(mk()), name=name)
+        findings = lint_dataflow(df)
+        if findings:
+            rc = 1
+            print(f"{name}: {len(findings)} finding(s)")
+            for f in findings:
+                print(f"  {f}")
+        else:
+            print(f"{name}: clean")
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "paths", nargs="*",
+        help="SLT files to check (default: tests/slt/*.slt)",
+    )
+    ap.add_argument(
+        "--bench", action="store_true",
+        help="jaxpr-lint the standard bench dataflows instead",
+    )
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    if args.bench:
+        return run_bench_mode(args.verbose)
+    paths = args.paths or sorted(
+        glob.glob(os.path.join(REPO, "tests", "slt", "*.slt"))
+    )
+    if not paths:
+        print("no SLT files found", file=sys.stderr)
+        return 2
+    return run_slt_mode(paths, args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

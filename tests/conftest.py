@@ -53,6 +53,29 @@ def _hard_exit():
 atexit.register(_hard_exit)
 
 
+# -- optimizer typecheck safety net ------------------------------------------
+# The MIR typechecker (materialize_tpu/analysis/typecheck.py) runs between
+# every optimizer transform for the whole suite, so a transform that
+# corrupts schemas or binding discipline fails loudly AT that transform
+# (transform/src/typecheck.rs discipline) instead of surfacing as a wrong
+# SLT result three layers later. Production default is off (dyncfg
+# optimizer_typecheck); tests pay the small planning overhead gladly.
+from materialize_tpu.utils.dyncfg import COMPUTE_CONFIGS  # noqa: E402
+
+COMPUTE_CONFIGS.update({"optimizer_typecheck": True})
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "analysis: static-analysis lane (typechecker, monotonicity, "
+        "jaxpr linter) — run fast with `pytest -m analysis`",
+    )
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 lane (-m 'not slow')"
+    )
+
+
 # -- replica-worker leak control ---------------------------------------------
 # Many tests spawn in-process ReplicaWorkers via serve_forever threads and
 # never stop them; a leaked replica keeps STEPPING its installed dataflows

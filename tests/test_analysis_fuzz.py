@@ -1,0 +1,335 @@
+"""Optimizer fuzz harness: random small MIR trees, checked two ways.
+
+For every generated tree ``e``:
+
+1. ``optimize(e)`` runs with the per-transform typechecker on (the
+   suite-wide ``optimizer_typecheck`` dyncfg from conftest.py), so any
+   transform producing an invalid plan fails with blame attribution;
+   the optimized plan is additionally typechecked and LIR-checked.
+2. ``optimize(e)`` evaluates identically to ``e`` under a pure-Python
+   multiset interpreter of MIR semantics, with results compared via
+   tests/oracle.py — the differential-collection oracle (the
+   reference's datadriven transform fixtures analog).
+
+The interpreter is deliberately independent of the device path: plain
+dict arithmetic over (row -> multiplicity) multisets, so an optimizer
+bug cannot hide behind a matching render-layer bug.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from materialize_tpu.expr import relation as mir
+from materialize_tpu.expr import scalar as ms
+from materialize_tpu.expr.relation import AggregateExpr, AggregateFunc
+from materialize_tpu.expr.scalar import col, lit
+from materialize_tpu.repr.schema import Column, ColumnType, Schema
+from .oracle import as_multiset
+
+pytestmark = pytest.mark.analysis
+
+I64 = ColumnType.INT64
+T = Schema((Column("a", I64), Column("b", I64)))
+U = Schema((Column("x", I64), Column("y", I64)))
+
+SOURCES = {
+    "t": (T, {(1, 10): 1, (2, 20): 1, (2, 21): 2, (3, 30): 1}),
+    "u": (U, {(1, 10): 1, (2, 20): 1, (4, 40): 1}),
+}
+
+_WRAP = 1 << 64
+_SIGN = 1 << 63
+
+
+def _wrap64(v: int) -> int:
+    return ((v + _SIGN) % _WRAP) - _SIGN
+
+
+# -- scalar interpreter -------------------------------------------------------
+
+
+def eval_scalar(e: ms.ScalarExpr, row: tuple):
+    if isinstance(e, ms.ColumnRef):
+        return row[e.index]
+    if isinstance(e, ms.Literal):
+        return e.value
+    if isinstance(e, ms.CallUnary):
+        v = eval_scalar(e.expr, row)
+        if e.func == ms.UnaryFunc.NEG:
+            return None if v is None else _wrap64(-v)
+        if e.func == ms.UnaryFunc.NOT:
+            return None if v is None else (not v)
+        if e.func == ms.UnaryFunc.ABS:
+            return None if v is None else _wrap64(abs(v))
+        if e.func == ms.UnaryFunc.IS_NULL:
+            return v is None
+        raise NotImplementedError(e.func)
+    if isinstance(e, ms.CallBinary):
+        l = eval_scalar(e.left, row)
+        r = eval_scalar(e.right, row)
+        if l is None or r is None:
+            return None
+        f = e.func
+        if f == ms.BinaryFunc.ADD:
+            return _wrap64(l + r)
+        if f == ms.BinaryFunc.SUB:
+            return _wrap64(l - r)
+        if f == ms.BinaryFunc.MUL:
+            return _wrap64(l * r)
+        cmp = {
+            ms.BinaryFunc.EQ: lambda a, b: a == b,
+            ms.BinaryFunc.NEQ: lambda a, b: a != b,
+            ms.BinaryFunc.LT: lambda a, b: a < b,
+            ms.BinaryFunc.LTE: lambda a, b: a <= b,
+            ms.BinaryFunc.GT: lambda a, b: a > b,
+            ms.BinaryFunc.GTE: lambda a, b: a >= b,
+        }
+        if f in cmp:
+            return cmp[f](l, r)
+        raise NotImplementedError(f)
+    if isinstance(e, ms.CallVariadic):
+        vs = [eval_scalar(x, row) for x in e.exprs]
+        if e.func == ms.VariadicFunc.AND:
+            if any(v is False for v in vs):
+                return False
+            return None if any(v is None for v in vs) else True
+        if e.func == ms.VariadicFunc.OR:
+            if any(v is True for v in vs):
+                return True
+            return None if any(v is None for v in vs) else False
+        if e.func == ms.VariadicFunc.COALESCE:
+            for v in vs:
+                if v is not None:
+                    return v
+            return None
+        raise NotImplementedError(e.func)
+    if isinstance(e, ms.If):
+        c = eval_scalar(e.cond, row)
+        return eval_scalar(e.then if c is True else e.els, row)
+    raise NotImplementedError(type(e))
+
+
+# -- relation interpreter -----------------------------------------------------
+
+
+def interpret(e: mir.RelationExpr, env: dict) -> dict:
+    """Multiset {row_tuple: multiplicity} semantics of MIR."""
+    if isinstance(e, mir.Constant):
+        out: dict = {}
+        for vals, d in e.rows:
+            out[tuple(vals)] = out.get(tuple(vals), 0) + d
+        return {k: v for k, v in out.items() if v != 0}
+    if isinstance(e, mir.Get):
+        if e.name in env:
+            return dict(env[e.name])
+        return dict(SOURCES[e.name][1])
+    if isinstance(e, mir.Let):
+        env2 = dict(env)
+        env2[e.name] = interpret(e.value, env)
+        return interpret(e.body, env2)
+    if isinstance(e, mir.Project):
+        out = {}
+        for row, d in interpret(e.input, env).items():
+            k = tuple(row[i] for i in e.outputs)
+            out[k] = out.get(k, 0) + d
+        return {k: v for k, v in out.items() if v != 0}
+    if isinstance(e, mir.Map):
+        out = {}
+        for row, d in interpret(e.input, env).items():
+            ext = list(row)
+            for s in e.scalars:
+                ext.append(eval_scalar(s, tuple(ext)))
+            k = tuple(ext)
+            out[k] = out.get(k, 0) + d
+        return out
+    if isinstance(e, mir.Filter):
+        out = {}
+        for row, d in interpret(e.input, env).items():
+            if all(
+                eval_scalar(p, row) is True for p in e.predicates
+            ):
+                out[row] = out.get(row, 0) + d
+        return out
+    if isinstance(e, mir.Join):
+        parts = [interpret(i, env) for i in e.inputs]
+        acc = {(): 1}
+        for p in parts:
+            nxt = {}
+            for row, d in acc.items():
+                for r2, d2 in p.items():
+                    nxt[row + r2] = nxt.get(row + r2, 0) + d * d2
+            acc = nxt
+        out = {}
+        for row, d in acc.items():
+            ok = True
+            for cls in e.equivalences:
+                vals = [eval_scalar(m, row) for m in cls]
+                if any(v is None for v in vals) or any(
+                    v != vals[0] for v in vals[1:]
+                ):
+                    ok = False
+                    break
+            if ok and d != 0:
+                out[row] = out.get(row, 0) + d
+        return {k: v for k, v in out.items() if v != 0}
+    if isinstance(e, mir.Union):
+        out = {}
+        for i in e.inputs:
+            for row, d in interpret(i, env).items():
+                out[row] = out.get(row, 0) + d
+        return {k: v for k, v in out.items() if v != 0}
+    if isinstance(e, mir.Negate):
+        return {
+            row: -d for row, d in interpret(e.input, env).items()
+        }
+    if isinstance(e, mir.Threshold):
+        return {
+            row: d
+            for row, d in interpret(e.input, env).items()
+            if d > 0
+        }
+    if isinstance(e, mir.Reduce):
+        groups: dict = {}
+        for row, d in interpret(e.input, env).items():
+            k = tuple(row[i] for i in e.group_key)
+            groups.setdefault(k, []).append((row, d))
+        out = {}
+        for k, rows in groups.items():
+            total = sum(d for _, d in rows)
+            if total <= 0:
+                continue
+            aggs = []
+            for a in e.aggregates:
+                if a.func is AggregateFunc.COUNT:
+                    aggs.append(total)
+                elif a.func is AggregateFunc.SUM_INT:
+                    aggs.append(
+                        _wrap64(
+                            sum(
+                                d * eval_scalar(a.expr, row)
+                                for row, d in rows
+                            )
+                        )
+                    )
+                else:
+                    raise NotImplementedError(a.func)
+            out[k + tuple(aggs)] = 1
+        return out
+    raise NotImplementedError(type(e).__name__)
+
+
+# -- generator ----------------------------------------------------------------
+
+
+def _has_negate(e) -> bool:
+    if isinstance(e, mir.Negate):
+        return True
+    return any(_has_negate(c) for c in e.children())
+
+
+def gen_expr(rng: random.Random, depth: int) -> mir.RelationExpr:
+    if depth <= 0:
+        name = rng.choice(list(SOURCES))
+        return mir.Get(name, SOURCES[name][0])
+    choice = rng.randrange(10)
+    if choice == 0:
+        name = rng.choice(list(SOURCES))
+        return mir.Get(name, SOURCES[name][0])
+    inner = gen_expr(rng, depth - 1)
+    arity = inner.schema().arity
+    if choice == 1:  # Project: random nonempty column pick
+        n = rng.randrange(1, arity + 1)
+        outs = tuple(rng.randrange(arity) for _ in range(n))
+        return mir.Project(inner, outs)
+    if choice == 2:  # Map: arithmetic over random columns
+        a, b = rng.randrange(arity), rng.randrange(arity)
+        op = rng.choice(
+            [ms.BinaryFunc.ADD, ms.BinaryFunc.SUB, ms.BinaryFunc.MUL]
+        )
+        return mir.Map(
+            inner,
+            (ms.CallBinary(op, col(a), col(b)),),
+        )
+    if choice == 3:  # Filter: col vs literal or col vs col
+        a = rng.randrange(arity)
+        cmp = rng.choice(
+            [ms.BinaryFunc.LT, ms.BinaryFunc.LTE, ms.BinaryFunc.GT,
+             ms.BinaryFunc.EQ, ms.BinaryFunc.NEQ]
+        )
+        rhs = (
+            lit(rng.randrange(0, 25))
+            if rng.random() < 0.7
+            else col(rng.randrange(arity))
+        )
+        return mir.Filter(inner, (ms.CallBinary(cmp, col(a), rhs),))
+    if choice == 4:  # Union of two filtered variants of the same input
+        a = rng.randrange(arity)
+        f1 = mir.Filter(inner, (col(a).lt(lit(rng.randrange(30))),))
+        f2 = mir.Filter(inner, (col(a).gte(lit(rng.randrange(30))),))
+        return mir.Union((f1, f2))
+    if choice == 5:
+        return mir.Negate(inner)
+    if choice == 6:
+        return mir.Threshold(inner)
+    if choice == 7:  # Distinct
+        return mir.Reduce(inner, tuple(range(arity)), ())
+    if choice == 8 and not _has_negate(inner):  # grouped aggregation
+        k = rng.randrange(arity)
+        v = rng.randrange(arity)
+        return mir.Reduce(
+            inner,
+            (k,),
+            (
+                AggregateExpr(AggregateFunc.COUNT, lit(True)),
+                AggregateExpr(AggregateFunc.SUM_INT, col(v)),
+            ),
+        )
+    # Join with an independent subtree on one equivalence
+    other = gen_expr(rng, depth - 1)
+    a2 = other.schema().arity
+    i = rng.randrange(arity)
+    j = rng.randrange(a2)
+    return mir.Join(
+        (inner, other), ((col(i), col(arity + j)),)
+    )
+
+
+# -- the harness --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_optimized_plan_typechecks_and_agrees(seed):
+    from materialize_tpu.analysis import typecheck, typecheck_lir
+    from materialize_tpu.transform.optimizer import optimize
+
+    rng = random.Random(seed)
+    e = gen_expr(rng, rng.choice([2, 3, 3, 4]))
+    typecheck(e)
+    opt = optimize(e)  # per-transform typecheck is on suite-wide
+    typecheck(opt)
+    typecheck_lir(opt)
+
+    want = interpret(e, {})
+    got = interpret(opt, {})
+    assert got == want, (
+        f"seed {seed}: optimized plan disagrees with the oracle\n"
+        f"  expr: {e}\n  opt:  {opt}\n"
+        f"  want {sorted(want.items())}\n  got  {sorted(got.items())}"
+    )
+
+
+def test_interpreter_matches_oracle_consolidation():
+    """The interpreter's multisets agree with tests/oracle.py's
+    consolidation of the row-stream form."""
+    e = mir.Union(
+        (mir.Get("t", T), mir.Negate(mir.Get("t", T)))
+    )
+    got = interpret(e, {})
+    rows = []
+    for row, d in SOURCES["t"][1].items():
+        rows.append(row + (0, d))
+        rows.append(row + (0, -d))
+    assert got == as_multiset(rows) == {}

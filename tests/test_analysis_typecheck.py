@@ -1,0 +1,444 @@
+"""Typechecker + monotonicity analysis unit tests (the `-m analysis`
+lane; doc/analysis.md catalogues the invariants exercised here)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from materialize_tpu.analysis import (
+    BOTTOM,
+    TOP,
+    Facts,
+    TransformTypecheckError,
+    TypecheckError,
+    analyze,
+    typecheck,
+    typecheck_lir,
+)
+from materialize_tpu.expr import relation as mir
+from materialize_tpu.expr import scalar as ms
+from materialize_tpu.expr.relation import AggregateExpr, AggregateFunc
+from materialize_tpu.expr.scalar import col, lit
+from materialize_tpu.repr.schema import Column, ColumnType, Schema
+
+pytestmark = pytest.mark.analysis
+
+T2 = Schema((Column("a", ColumnType.INT64), Column("b", ColumnType.INT64)))
+T1 = Schema((Column("a", ColumnType.INT64),))
+T1N = Schema((Column("a", ColumnType.INT64, True),))
+
+
+# -- typecheck: accepts -------------------------------------------------------
+
+
+def test_ok_pipeline_schema_flows():
+    e = (
+        mir.Get("t", T2)
+        .filter([col(0).gt(lit(1))])
+        .map([col(0) + col(1)])
+        .project([2, 0])
+        .reduce((1,), (AggregateExpr(AggregateFunc.SUM_INT, col(0)),))
+    )
+    sch = typecheck(e)
+    assert sch.arity == 2
+    typecheck_lir(e)
+
+
+def test_ok_let_binding():
+    v = mir.Get("t", T2).filter([col(0).gt(lit(0))])
+    e = mir.Let("x", v, mir.Union((mir.Get("x", T2), mir.Get("x", T2))))
+    assert typecheck(e).arity == 2
+
+
+# -- typecheck: rejects -------------------------------------------------------
+
+
+def test_project_out_of_bounds():
+    with pytest.raises(TypecheckError, match="T-ARITY"):
+        typecheck(mir.Project(mir.Get("t", T2), (0, 5)))
+
+
+def test_map_scalar_ref_out_of_bounds():
+    with pytest.raises(TypecheckError, match="T-ARITY"):
+        typecheck(mir.Map(mir.Get("t", T2), (col(7),)))
+
+
+def test_filter_predicate_must_be_bool():
+    with pytest.raises(TypecheckError, match="not bool"):
+        typecheck(mir.Filter(mir.Get("t", T2), (col(0) + col(1),)))
+
+
+def test_union_arity_mismatch():
+    with pytest.raises(TypecheckError, match="arity"):
+        typecheck(mir.Union((mir.Get("t", T2), mir.Get("u", T1))))
+
+
+def test_union_type_mismatch():
+    f = Schema((Column("a", ColumnType.FLOAT64),))
+    with pytest.raises(TypecheckError, match="type"):
+        typecheck(mir.Union((mir.Get("t", T1), mir.Get("u", f))))
+
+
+def test_let_shadowing_rejected():
+    inner = mir.Let("x", mir.Get("t", T2), mir.Get("x", T2))
+    with pytest.raises(TypecheckError, match="rebinds"):
+        typecheck(mir.Let("x", mir.Get("u", T2), inner))
+
+
+def test_get_schema_must_match_binding():
+    e = mir.Let("x", mir.Get("t", T2), mir.Get("x", T1))
+    with pytest.raises(TypecheckError, match="T-BIND"):
+        typecheck(e)
+
+
+def test_dangling_get_of_dropped_binding_rejected():
+    """A transform that removes a Let but leaves a Get of its name
+    (the classic buggy-inlining shape) must fail T-BIND, not be
+    mistaken for a source."""
+    # Get("x") outside the Let("x", ...) scope: the binder is in the
+    # tree (left Union branch) but not in scope at the dangling Get.
+    bound = mir.Let("x", mir.Get("t", T2), mir.Get("x", T2))
+    e = mir.Union((bound, mir.Get("x", T2)))
+    with pytest.raises(TypecheckError, match="dangling"):
+        typecheck(e)
+
+
+def test_letrec_value_schema_must_match_declared():
+    e = mir.LetRec(
+        ("r",),
+        (mir.Get("t", T2),),
+        (T1,),  # declares arity 1, value has arity 2
+        mir.Get("r", T1),
+    )
+    with pytest.raises(TypecheckError, match="T-BIND"):
+        typecheck(e)
+
+
+def test_reduce_group_key_out_of_bounds():
+    with pytest.raises(TypecheckError, match="group key"):
+        typecheck(mir.Reduce(mir.Get("t", T2), (4,), ()))
+
+
+def test_topk_order_col_out_of_bounds():
+    with pytest.raises(TypecheckError, match="order_by"):
+        typecheck(
+            mir.TopK(mir.Get("t", T2), (0,), ((9, False, False),), 1)
+        )
+
+
+def test_join_singleton_equivalence_class_rejected():
+    j = mir.Join(
+        (mir.Get("t", T2), mir.Get("u", T2)), ((col(0),),)
+    )
+    with pytest.raises(TypecheckError, match="equivalence class"):
+        typecheck(j)
+
+
+def test_sources_mapping_checked():
+    with pytest.raises(TypecheckError, match="T-BIND"):
+        typecheck(mir.Get("t", T1), sources={"t": T2})
+
+
+# -- blame attribution --------------------------------------------------------
+
+
+def test_transform_blame_names_the_transform():
+    from materialize_tpu.transform.optimizer import _run_checked
+
+    def evil_transform(e):
+        return mir.Project(e, (99,))
+
+    with pytest.raises(TransformTypecheckError, match="evil_transform"):
+        _run_checked(mir.Get("t", T2), evil_transform)
+
+
+def test_transform_blame_on_type_change():
+    from materialize_tpu.transform.optimizer import _run_checked
+
+    def drops_a_column(e):
+        return mir.Project(e, (0,))
+
+    with pytest.raises(
+        TransformTypecheckError, match="drops_a_column"
+    ):
+        _run_checked(mir.Get("t", T2), drops_a_column)
+
+
+def test_optimizer_runs_clean_under_typecheck_flag():
+    # conftest turns optimizer_typecheck on for the whole suite; a
+    # representative multi-transform plan must survive the full
+    # pipeline with the net in place.
+    from materialize_tpu.transform.optimizer import optimize
+
+    e = (
+        mir.Join(
+            (mir.Get("t", T2), mir.Get("u", T2), mir.Get("v", T2)),
+            ((col(0), col(2)), (col(3), col(4))),
+        )
+        .filter([col(1).gt(lit(0))])
+        .project([0, 1, 5])
+    )
+    opt = optimize(e)
+    typecheck(opt)
+    typecheck_lir(opt)
+
+
+# -- union nullability lub ----------------------------------------------------
+
+
+def test_union_schema_nullability_is_lub():
+    u = mir.Union((mir.Get("t", T1), mir.Get("u", T1N)))
+    assert u.schema()[0].nullable
+    assert typecheck(u)[0].nullable
+
+
+def test_column_knowledge_respects_union_nullability():
+    """IS_NULL over a union with a nullable branch must NOT fold to
+    false (the unsoundness the old branch-0-only Union.schema allowed)."""
+    from materialize_tpu.transform.optimizer import column_knowledge
+
+    u = mir.Union((mir.Get("t", T1), mir.Get("u", T1N)))
+    f = mir.Filter(
+        u, (ms.CallUnary(ms.UnaryFunc.IS_NULL, col(0)),)
+    )
+    out = column_knowledge(f)
+    assert isinstance(out, mir.Filter)
+    assert not isinstance(out.predicates[0], ms.Literal)
+
+
+# -- monotonicity lattice -----------------------------------------------------
+
+
+def test_facts_lattice_basics():
+    assert TOP.meet(BOTTOM) == BOTTOM
+    assert Facts(True, False).meet(TOP) == Facts(True, False)
+    with pytest.raises(ValueError):
+        Facts(nonneg=False, append_only=True)
+
+
+def test_sources_default_nonneg_not_append_only():
+    f = analyze(mir.Get("t", T2))
+    assert f.nonneg and not f.append_only
+
+
+def test_negate_kills_both_facts():
+    f = analyze(mir.Negate(mir.Get("t", T2)))
+    assert f == BOTTOM
+
+
+def test_threshold_restores_nonneg():
+    f = analyze(mir.Threshold(mir.Negate(mir.Get("t", T2))))
+    assert f.nonneg and not f.append_only
+
+
+def test_reduce_is_nonneg_never_append_only():
+    e = mir.Reduce(mir.Get("t", T2), (0,), ())
+    f = analyze(e, source_facts={"t": TOP})
+    assert f.nonneg and not f.append_only
+
+
+def test_append_only_source_flows_through_mfp():
+    e = mir.Get("t", T2).filter([col(0).gt(lit(0))]).project([1])
+    assert analyze(e, source_facts={"t": TOP}).append_only
+    assert not analyze(e).append_only
+
+
+def test_let_env_resolves_binding_facts():
+    neg = mir.Negate(mir.Get("t", T2))
+    e = mir.Let("b", neg, mir.Get("b", T2))
+    assert analyze(e) == BOTTOM
+    pos = mir.Get("t", T2)
+    e2 = mir.Let("b", pos, mir.Get("b", T2))
+    assert analyze(e2).nonneg
+
+
+def test_plan_decisions_monotonic_delegates():
+    from materialize_tpu.plan.decisions import monotonic
+
+    e = mir.Get("t", T2).filter([col(0).gt(lit(0))])
+    assert monotonic(e, {"t"})
+    assert not monotonic(e, frozenset())
+    # through a Let binding
+    le = mir.Let("b", e, mir.Get("b", T2))
+    assert monotonic(le, {"t"})
+
+
+# -- threshold elision regression (the Let/Negate unsoundness) ---------------
+
+
+def _run(expr, inputs):
+    from materialize_tpu.render.dataflow import Dataflow
+    from materialize_tpu.repr.batch import Batch
+
+    df = Dataflow(expr)
+    df.step(inputs)
+    acc: dict = {}
+    for r in df.peek():
+        acc[r[:-2]] = acc.get(r[:-2], 0) + r[-1]
+    return {k: d for k, d in acc.items() if d != 0}
+
+
+def _batch(schema, rows, diffs=None):
+    from materialize_tpu.repr.batch import Batch
+
+    cols = [
+        np.asarray([r[i] for r in rows]) for i in range(schema.arity)
+    ]
+    d = (
+        np.asarray(diffs, np.int64)
+        if diffs is not None
+        else np.ones(len(rows), np.int64)
+    )
+    return Batch.from_numpy(schema, cols, np.uint64(0), d)
+
+
+def test_threshold_elision_let_negate_regression():
+    """A Get of a Let binding whose value contains Negate can carry
+    negative diffs: eliding the Threshold over it is unsound (the old
+    ad-hoc nonneg closure assumed every Get non-negative). The binding
+    must be resolved through the environment."""
+    from materialize_tpu.transform.optimizer import threshold_elision
+
+    val = mir.Union((mir.Get("t", T1), mir.Negate(mir.Get("u", T1))))
+    e = mir.Let("b", val, mir.Threshold(mir.Get("b", T1)))
+    out = threshold_elision(e)
+    assert isinstance(out, mir.Let)
+    assert isinstance(out.body, mir.Threshold), (
+        "Threshold over a Let-bound negated union was elided — "
+        "negative multiplicities would leak"
+    )
+
+    # A nonneg binding still elides.
+    e2 = mir.Let(
+        "b", mir.Get("t", T1), mir.Threshold(mir.Get("b", T1))
+    )
+    assert not isinstance(threshold_elision(e2).body, mir.Threshold)
+
+
+def test_threshold_elision_regression_end_to_end():
+    """EXCEPT-shaped plan through the full optimizer + dataflow: with
+    u ⊋ t the thresholded difference is empty, never negative."""
+    from materialize_tpu.transform.optimizer import optimize
+
+    val = mir.Union((mir.Get("t", T1), mir.Negate(mir.Get("u", T1))))
+    e = mir.Let("b", val, mir.Threshold(mir.Get("b", T1)))
+    opt = optimize(e)
+    typecheck(opt)
+    got = _run(
+        opt,
+        {"t": _batch(T1, [(1,)]), "u": _batch(T1, [(1,), (2,)])},
+    )
+    assert got == {}, f"negative multiplicity leaked: {got}"
+
+
+# -- EXPLAIN ANALYSIS surfacing ----------------------------------------------
+
+
+def test_explain_analysis_stage():
+    from materialize_tpu.sql.catalog import Catalog, CatalogItem
+    from materialize_tpu.sql.plan import ExplainPlan, plan_statement
+
+    cat = Catalog()
+    cat.create(CatalogItem("t", "table", T2))
+    plan = plan_statement(
+        "EXPLAIN ANALYSIS SELECT a, count(*) FROM t GROUP BY a", cat
+    )
+    assert isinstance(plan, ExplainPlan)
+    assert plan.stage == "analysis"
+    assert "typecheck: ok" in plan.text
+    assert "monotonicity:" in plan.text
+    assert "lir: ok" in plan.text
+
+
+# -- register-time guard (production default: optimizer_typecheck off) --------
+
+
+def test_register_time_typecheck_guards_durable_dataflows():
+    """With the optimizer_typecheck dyncfg OFF (the production
+    default), _register_dataflow typechecks DURABLE plans before
+    anything ships to replicas, and transient peeks skip the check
+    (it would sit on every slow-path SELECT's latency). The guard
+    precedes all coordinator state, so a bare instance pins the
+    ordering: rejection must happen before any controller/state
+    access."""
+    from materialize_tpu.coord.coordinator import Coordinator
+    from materialize_tpu.coord.protocol import DataflowDescription
+    from materialize_tpu.utils.dyncfg import COMPUTE_CONFIGS
+
+    coord = Coordinator.__new__(Coordinator)  # no __init__: guard-only
+    bad = mir.Project(mir.Get("t", T2), (0, 5))
+    desc = DataflowDescription(
+        name="mv", expr=bad, source_imports={}, sink_shard="s",
+        index_imports={},
+    )
+    COMPUTE_CONFIGS.update({"optimizer_typecheck": False})
+    try:
+        with pytest.raises(TypecheckError, match="T-ARITY"):
+            coord._register_dataflow(desc)
+        # durable=False (transient peek) skips the guard: the same bad
+        # plan sails past it and fails only on the uninitialized
+        # coordinator state the guard is required to precede.
+        with pytest.raises(AttributeError):
+            coord._register_dataflow(desc, durable=False)
+    finally:
+        COMPUTE_CONFIGS.update({"optimizer_typecheck": True})
+
+
+def test_durable_ddl_end_to_end_with_typecheck_flag_off(tmp_path):
+    """The whole suite runs with optimizer_typecheck ON (conftest), so
+    without this test the production configuration — flag off, with
+    _register_dataflow's guard as the only typecheck — would never be
+    executed by CI. A typechecker false positive on a valid plan would
+    then pass CI green and fail every production CREATE MATERIALIZED
+    VIEW (and brick bootstrap's DDL replay). Run real DDL through a
+    coordinator + replica with the flag at its production default."""
+    import threading
+
+    from materialize_tpu.coord.coordinator import Coordinator
+    from materialize_tpu.coord.protocol import PersistLocation
+    from materialize_tpu.coord.replica import serve_forever
+    from materialize_tpu.storage.persist import (
+        FileBlob,
+        PersistClient,
+        SqliteConsensus,
+    )
+    from materialize_tpu.utils.dyncfg import COMPUTE_CONFIGS
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    loc = PersistLocation(
+        str(tmp_path / "blob"), str(tmp_path / "consensus.db")
+    )
+    ready = threading.Event()
+    threading.Thread(
+        target=serve_forever, args=(port, loc, "r0", ready), daemon=True
+    ).start()
+    assert ready.wait(30)
+
+    COMPUTE_CONFIGS.update({"optimizer_typecheck": False})
+    coord = None
+    try:
+        coord = Coordinator(
+            PersistClient(
+                FileBlob(loc.blob_root),
+                SqliteConsensus(loc.consensus_path),
+            ),
+            tick_interval=None,
+        )
+        coord.add_replica("r0", ("127.0.0.1", port))
+        coord.execute("CREATE TABLE t (k INT, v INT)")
+        coord.execute("INSERT INTO t VALUES (1, 10), (1, 20), (2, 5)")
+        coord.execute(
+            "CREATE MATERIALIZED VIEW mv AS "
+            "SELECT k, sum(v) AS s FROM t GROUP BY k"
+        )
+        rows = sorted(coord.execute("SELECT k, s FROM mv").rows)
+        assert rows == [(1, 30), (2, 5)], rows
+    finally:
+        COMPUTE_CONFIGS.update({"optimizer_typecheck": True})
+        if coord is not None:
+            coord.shutdown()

@@ -1,0 +1,166 @@
+"""jaxpr TPU-hazard linter tests (the `-m analysis` lane).
+
+The two seeded hazard fixtures the acceptance gate names: a dataflow
+with a float64 literal (f64-leak) and a scan with a shape-varying
+carry (carry-vary) — both must fire with actionable messages — plus
+the zero-findings check on the standard bench dataflow (TPCH Q1)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from materialize_tpu.analysis import (
+    LintFinding,
+    lint_dataflow,
+    lint_jaxpr,
+    lint_step_fn,
+)
+from materialize_tpu.analysis.jaxpr_lint import (
+    BIG_CONST,
+    CARRY_VARY,
+    DYN_SHAPE,
+    F64_LEAK,
+    HOST_CALLBACK,
+)
+from materialize_tpu.expr import relation as mir
+from materialize_tpu.expr.relation import AggregateExpr, AggregateFunc
+from materialize_tpu.expr.scalar import col, lit
+from materialize_tpu.repr.schema import Column, ColumnType, Schema
+
+pytestmark = pytest.mark.analysis
+
+T1 = Schema((Column("a", ColumnType.INT64),))
+
+
+def _mk_dataflow(expr):
+    from materialize_tpu.render.dataflow import Dataflow
+
+    return Dataflow(expr)
+
+
+# -- seeded hazard fixture 1: float64 literal in a dataflow -------------------
+
+
+def test_f64_literal_dataflow_flagged():
+    df = _mk_dataflow(
+        mir.Map(mir.Get("t", T1), (lit(1.5, ColumnType.FLOAT64),))
+    )
+    findings = lint_dataflow(df)
+    ids = {f.lint_id for f in findings}
+    assert ids == {F64_LEAK}, findings
+    msg = next(f.message for f in findings)
+    # actionable: names the hazard and the fix directions
+    assert "float64" in msg
+    assert "literal" in msg or "DECIMAL" in msg
+
+
+# -- seeded hazard fixture 2: shape-varying scan carry ------------------------
+
+
+def test_shape_varying_carry_flagged():
+    def bad_step(x):
+        def body(carry, _):
+            # carry doubles every iteration: the recompile hazard the
+            # ingest-ring work guards against by hand
+            return jnp.concatenate([carry, carry]), ()
+
+        return jax.lax.scan(body, x, None, length=4)
+
+    findings = lint_step_fn(bad_step, jnp.zeros((8,), jnp.int64))
+    assert [f.lint_id for f in findings] == [CARRY_VARY]
+    msg = findings[0].message
+    assert "carry" in msg
+    assert "capacity tier" in msg  # the actionable fix
+
+
+def test_dtype_varying_while_carry_flagged():
+    def bad_step(x):
+        def cond(c):
+            return jnp.sum(c) < 10
+
+        def body(c):
+            return c.astype(jnp.float32)
+
+        return jax.lax.while_loop(cond, body, x)
+
+    findings = lint_step_fn(bad_step, jnp.zeros((4,), jnp.int64))
+    assert [f.lint_id for f in findings] == [CARRY_VARY]
+
+
+# -- the other lints ----------------------------------------------------------
+
+
+def test_host_callback_flagged():
+    def step(x):
+        jax.debug.print("x = {x}", x=x)
+        return x + 1
+
+    findings = lint_step_fn(step, jnp.zeros((4,), jnp.int64))
+    assert HOST_CALLBACK in {f.lint_id for f in findings}
+    msg = next(
+        f.message for f in findings if f.lint_id == HOST_CALLBACK
+    )
+    assert "round trip" in msg
+
+
+def test_big_baked_constant_flagged():
+    big = jnp.asarray(np.arange(1 << 18, dtype=np.int64))  # 2 MiB
+
+    def step(x):
+        return x + big[:4]
+
+    findings = lint_step_fn(step, jnp.zeros((4,), jnp.int64))
+    assert BIG_CONST in {f.lint_id for f in findings}
+    # below the threshold: clean
+    small = jnp.asarray(np.arange(8, dtype=np.int64))
+    assert not lint_step_fn(
+        lambda x: x + small[:4], jnp.zeros((4,), jnp.int64)
+    )
+
+
+def test_clean_int_dataflow_no_findings():
+    df = _mk_dataflow(
+        mir.Get("t", T1).filter([col(0).gt(lit(1))])
+    )
+    assert lint_dataflow(df) == []
+
+
+def test_findings_deterministic_order():
+    def step(x):
+        jax.debug.print("x = {x}", x=x)
+        return x * jnp.float64(2.0)
+
+    a = lint_step_fn(step, jnp.zeros((4,), jnp.int64))
+    b = lint_step_fn(step, jnp.zeros((4,), jnp.int64))
+    assert a == b
+    assert [f.lint_id for f in a] == sorted(f.lint_id for f in a)
+
+
+# -- acceptance: the standard bench dataflow is clean -------------------------
+
+
+def test_bench_q1_dataflow_zero_findings():
+    from materialize_tpu.transform.optimizer import optimize
+    from materialize_tpu.workloads.tpch import q1_mir
+
+    df = _mk_dataflow(optimize(q1_mir()))
+    findings = lint_dataflow(df)
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_stateful_operators_trace_clean():
+    """Reduce/Join/TopK/Threshold state machinery (scans, sorts,
+    segmented ops) must itself be hazard-free."""
+    t = mir.Get("t", T1)
+    u = mir.Get(
+        "u", Schema((Column("x", ColumnType.INT64),))
+    )
+    e = mir.Join((t, u), ((col(0), col(1)),)).reduce(
+        (0,),
+        (AggregateExpr(AggregateFunc.COUNT, lit(True)),),
+    )
+    df = _mk_dataflow(e)
+    assert lint_dataflow(df) == []
