@@ -25,9 +25,13 @@ See doc/analysis.md for the catalogue of invariants and lints.
 
 from .jaxpr_lint import (  # noqa: F401
     LintFinding,
+    intermediate_bytes,
+    kernel_count,
     lint_dataflow,
     lint_jaxpr,
     lint_step_fn,
+    op_census,
+    trace_dataflow_step,
 )
 from .monotonic import (  # noqa: F401
     BOTTOM,

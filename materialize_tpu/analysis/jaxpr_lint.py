@@ -191,6 +191,60 @@ def lint_jaxpr(
     return findings
 
 
+def op_census(closed_jaxpr) -> dict:
+    """Primitive census of a (Closed)Jaxpr: primitive name ->
+    occurrence count, recursing into scan/while/cond/pjit bodies (each
+    body counted ONCE — the census approximates the program's kernel
+    count, i.e. how many distinct ops XLA must schedule, which is what
+    a launch-bound step program pays per dispatch; PERF_NOTES round
+    5)."""
+    from collections import Counter
+
+    counts: Counter = Counter()
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            counts[eqn.primitive.name] += 1
+            for _tag, sub, _consts in _subjaxprs_of_eqn(eqn):
+                walk(sub)
+
+    walk(jaxpr)
+    return dict(counts)
+
+
+def kernel_count(closed_jaxpr) -> int:
+    """Total op count of the census — the number the kernel budget
+    gate (scripts/check_plans.py --bench, tests/kernel_budget.json)
+    compares against."""
+    return sum(op_census(closed_jaxpr).values())
+
+
+def intermediate_bytes(closed_jaxpr) -> int:
+    """Sum of every eqn OUTPUT's aval size (recursive). The honest
+    per-dispatch WORK proxy: op count stays flat as capacities grow
+    (shapes change, the program doesn't), but a step that touches a
+    run0-sized array produces run0-sized outputs — so this number is
+    what the O(delta) scaling test pins flat across run0 capacities."""
+    total = 0
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+
+    def walk(jx):
+        nonlocal total
+        for eqn in jx.eqns:
+            for v in eqn.outvars:
+                aval = v.aval
+                size = getattr(aval, "size", 0)
+                dt = getattr(aval, "dtype", None)
+                if dt is not None:
+                    total += int(size) * np.dtype(dt).itemsize
+            for _tag, sub, _consts in _subjaxprs_of_eqn(eqn):
+                walk(sub)
+
+    walk(jaxpr)
+    return total
+
+
 _CARRY_ERROR_MARKERS = (
     "carry",
     "body_fun",
@@ -198,6 +252,27 @@ _CARRY_ERROR_MARKERS = (
     "same type structure",
     "differs from the carry",
 )
+
+
+def _carry_finding(e: TypeError) -> list[LintFinding] | None:
+    """Convert a trace-time carry-mismatch TypeError into the
+    carry-vary finding (None if the error is something else)."""
+    msg = str(e)
+    if not any(m in msg.lower() for m in _CARRY_ERROR_MARKERS):
+        return None
+    return [
+        LintFinding(
+            CARRY_VARY,
+            "<trace>",
+            "scan/while carry changes shape, dtype, or "
+            "structure between iterations — a recompile/trace "
+            "hazard on the hot path. Make every carried value "
+            "chunk-invariant: pad to a static capacity tier "
+            "and carry a row count, as the render layer does "
+            "for LetRec binding deltas and the ingest ring "
+            f"(render/dataflow.py). Trace error: {msg}",
+        )
+    ]
 
 
 def lint_step_fn(
@@ -210,21 +285,9 @@ def lint_step_fn(
     try:
         closed = jax.make_jaxpr(fn)(*args)
     except TypeError as e:
-        msg = str(e)
-        if any(m in msg.lower() for m in _CARRY_ERROR_MARKERS):
-            return [
-                LintFinding(
-                    CARRY_VARY,
-                    "<trace>",
-                    "scan/while carry changes shape, dtype, or "
-                    "structure between iterations — a recompile/trace "
-                    "hazard on the hot path. Make every carried value "
-                    "chunk-invariant: pad to a static capacity tier "
-                    "and carry a row count, as the render layer does "
-                    "for LetRec binding deltas and the ingest ring "
-                    f"(render/dataflow.py). Trace error: {msg}",
-                )
-            ]
+        findings = _carry_finding(e)
+        if findings is not None:
+            return findings
         raise
     return lint_jaxpr(closed, max_const_bytes)
 
@@ -259,21 +322,21 @@ def _unbound_gets(expr, env=None) -> dict:
     return out
 
 
-def lint_dataflow(
-    df,
-    input_cap: int = 256,
-    max_const_bytes: int = DEFAULT_MAX_CONST_BYTES,
-) -> list[LintFinding]:
-    """Lint a rendered ``Dataflow``'s step program: traces
-    ``_step_core`` with empty input batches at the dataflow's current
-    state capacities (abstract tracing only — nothing compiles or
-    runs) and walks the resulting jaxpr."""
+def trace_dataflow_step(df, input_cap: int = 256, hints: tuple = ()):
+    """Trace a rendered ``Dataflow``'s step program to a ClosedJaxpr
+    (abstract tracing only — nothing compiles or runs): empty input
+    batches at the dataflow's current state capacities. ``hints``
+    attaches producer hints to the traced inputs — pass
+    ``("hash_consolidated",)`` to trace the program the presorted
+    bench ingest actually runs (hints are trace-time facts, so the
+    hinted and unhinted step programs genuinely differ)."""
+    import jax
     import jax.numpy as jnp
 
     from ..repr.batch import Batch
 
     inputs = {
-        name: Batch.empty(sch, input_cap)
+        name: Batch.empty(sch, input_cap).replace(hints=hints)
         for name, sch in _unbound_gets(df.expr).items()
     }
     time = jnp.asarray(df.time, dtype=jnp.uint64)
@@ -283,8 +346,23 @@ def lint_dataflow(
     )
     if env is not None:
         args = args + (env,)
-    return lint_step_fn(
-        lambda *a: df._step_core(*a),
-        *args,
-        max_const_bytes=max_const_bytes,
-    )
+    return jax.make_jaxpr(lambda *a: df._step_core(*a))(*args)
+
+
+def lint_dataflow(
+    df,
+    input_cap: int = 256,
+    max_const_bytes: int = DEFAULT_MAX_CONST_BYTES,
+) -> list[LintFinding]:
+    """Lint a rendered ``Dataflow``'s step program: traces
+    ``_step_core`` with empty input batches at the dataflow's current
+    state capacities (abstract tracing only — nothing compiles or
+    runs) and walks the resulting jaxpr."""
+    try:
+        closed = trace_dataflow_step(df, input_cap)
+    except TypeError as e:
+        findings = _carry_finding(e)
+        if findings is not None:
+            return findings
+        raise
+    return lint_jaxpr(closed, max_const_bytes)

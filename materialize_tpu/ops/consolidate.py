@@ -7,12 +7,25 @@ compute/src/render.rs:1336+).
 TPU form (round-5 redesign, PERF_NOTES.md): sort by a HASH PAIR of the
 row (2 sort operands instead of one per column — sort compile time is
 superlinear in operand count), then detect segment boundaries with
-EXACT full-row lane comparison on adjacent rows (cheap elementwise, so
-correctness never depends on hash uniqueness: a collision can only
-place two different rows next to each other, never merge them), sum
-diffs per segment with scan+gather (no output-sized scatter-add), keep
-segment leaders with nonzero totals, compact to a prefix (one
-row-scatter per dtype family)."""
+EXACT adjacent-row comparison (cheap elementwise, so correctness never
+depends on hash uniqueness: a collision can only place two different
+rows next to each other, never merge them), sum diffs per segment with
+scan+gather (no output-sized scatter-add), keep segment leaders with
+nonzero totals, compact to a prefix (one row-scatter per dtype family).
+
+Round-6 kernel-budget work:
+- adjacent equality compares RAW COLUMNS (null-gated, NaN-aware)
+  instead of re-encoding order lanes per column — the encode chains
+  were ~8 eqns per column and dominated the op census;
+- consolidate outputs carry sortedness HINTS ("hash_sorted" /
+  "hash_consolidated") so a downstream arrange of the same order skips
+  its sort and re-consolidation (the step-level delta consolidate and
+  the output-index insert previously paid the full hash+sort chain
+  twice per step);
+- `consolidate_sorted_cached` carries a stacked ``[cap, L]`` lane
+  array through the compaction (same dest scatter as the rows), so
+  spine folds keep their cached run lanes without re-hashing.
+"""
 
 from __future__ import annotations
 
@@ -20,7 +33,9 @@ import jax
 import jax.numpy as jnp
 
 from ..repr.batch import Batch
+from ..repr.schema import ColumnType
 from .lanes import hash_pair, row_lanes
+from .rows2d import from_groups, scatter_rows, to_groups
 from .sort import apply_perm, compact, sort_perm
 
 
@@ -36,6 +51,13 @@ def consolidate(batch: Batch, include_time: bool = True) -> Batch:
         # skipping it removes the input-side device sort (the large-
         # micro-batch cost ceiling; PERF_NOTES.md).
         return batch
+    if "hash_sorted" in batch.hints:
+        # Already sorted content-hash-major (a previous consolidate's
+        # output): equal rows are adjacent, so only the cheap adjacent
+        # pass runs — no sort, no re-hash.
+        return _hinted(
+            _consolidate_adjacent(batch, include_time), include_time
+        )
     cap = batch.capacity
     h1, h2 = hash_pair(row_lanes(batch, include_time=False))
     ops = [h1, h2]
@@ -43,7 +65,20 @@ def consolidate(batch: Batch, include_time: bool = True) -> Batch:
         ops.append(batch.time.astype(jnp.uint64))
     perm = sort_perm(ops, batch.count, cap)
     sorted_batch = apply_perm(batch, perm)
-    return _consolidate_adjacent(sorted_batch, include_time)
+    return _hinted(
+        _consolidate_adjacent(sorted_batch, include_time), include_time
+    )
+
+
+def _hinted(batch: Batch, include_time: bool) -> Batch:
+    """Stamp a consolidate output with the sortedness fact it just
+    established: content-hash-major order with unique rows. With
+    include_time the batch may still hold one row per (content, time)
+    — "hash_sorted"; without, rows are unique by content —
+    "hash_consolidated" (the full producer guarantee)."""
+    return batch.replace(
+        hints=("hash_sorted",) if include_time else ("hash_consolidated",)
+    )
 
 
 def consolidate_sorted(batch: Batch, include_time: bool = False) -> Batch:
@@ -54,6 +89,16 @@ def consolidate_sorted(batch: Batch, include_time: bool = False) -> Batch:
     intended caller: a merge of two same-order runs preserves
     adjacency of equal rows."""
     return _consolidate_adjacent(batch, include_time)
+
+
+def consolidate_sorted_cached(
+    batch: Batch, lanes_2d: jnp.ndarray, include_time: bool = False
+) -> tuple[Batch, jnp.ndarray]:
+    """consolidate_sorted carrying a stacked ``[cap, L]`` lane array:
+    surviving rows' lanes ride the same compaction scatter as the rows
+    themselves, so a spine fold's cached run lanes stay valid with no
+    re-hashing (arrangement/spine.py lane cache)."""
+    return _consolidate_adjacent(batch, include_time, lanes_2d)
 
 
 def _segment_totals(starts, diffs):
@@ -78,19 +123,66 @@ def _segment_totals(starts, diffs):
     return upper - lower
 
 
-def _consolidate_adjacent(sorted_batch: Batch, include_time: bool) -> Batch:
+def adjacent_equal(batch: Batch, include_time: bool) -> jnp.ndarray:
+    """``[cap-1]`` bool: is row i+1 content-equal to row i? SQL
+    equality on raw columns: NULLs equal each other (and nothing
+    else), NaNs equal each other, -0.0 == 0.0 — exactly the equalities
+    the order-lane encoding (ops/lanes.py) identifies, without
+    re-encoding every column (~8 eqns/column saved from the per-step
+    op census)."""
+    cap = batch.capacity
+    same = jnp.ones(max(cap - 1, 0), dtype=bool)
+    for col, arr, nl in zip(batch.schema.columns, batch.cols, batch.nulls):
+        a, b = arr[1:], arr[:-1]
+        if col.ctype is ColumnType.FLOAT64:
+            eq = jnp.logical_or(
+                a == b, jnp.logical_and(a != a, b != b)
+            )
+        else:
+            eq = a == b
+        if nl is not None:
+            n1, n0 = nl[1:], nl[:-1]
+            eq = jnp.where(n1, n0, jnp.logical_and(~n0, eq))
+        same = jnp.logical_and(same, eq)
+    if include_time:
+        same = jnp.logical_and(same, batch.time[1:] == batch.time[:-1])
+    return same
+
+
+def _consolidate_adjacent(
+    sorted_batch: Batch, include_time: bool, lanes_2d=None
+):
     cap = sorted_batch.capacity
-    ex_lanes = row_lanes(sorted_batch, include_time=include_time)
+    if cap == 0:
+        return (
+            sorted_batch
+            if lanes_2d is None
+            else (sorted_batch, lanes_2d)
+        )
     valid = sorted_batch.valid_mask()
     # Exact adjacent-equality boundaries.
     starts = jnp.ones(cap, dtype=bool)
     if cap > 1:
-        same = jnp.ones(cap - 1, dtype=bool)
-        for l in ex_lanes:
-            same = jnp.logical_and(same, l[1:] == l[:-1])
-        starts = starts.at[1:].set(jnp.logical_not(same))
+        starts = starts.at[1:].set(
+            jnp.logical_not(adjacent_equal(sorted_batch, include_time))
+        )
     diffs = jnp.where(valid, sorted_batch.diff, 0)
     row_total = _segment_totals(starts, diffs)
     keep = jnp.logical_and(starts, row_total != 0)
     out = sorted_batch.replace(diff=jnp.where(starts, row_total, 0))
-    return compact(out, keep)
+    if lanes_2d is None:
+        return compact(out, keep)
+    # Lane-carrying compaction: the same keep/dest discipline as
+    # ops/sort.compact, with the lane rows riding the identical dest
+    # scatter (compact() cannot return its dest, and recomputing it
+    # from a second cumsum downstream would trace the reduction twice).
+    keep = jnp.logical_and(keep, valid)
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    new_count = (pos[-1] + 1).astype(jnp.int32)
+    dest = jnp.where(keep, pos, cap)  # cap is out of range -> dropped
+    groups = scatter_rows(to_groups(out), dest, cap)
+    compacted = from_groups(groups, out, new_count)
+    new_lanes = (
+        jnp.zeros_like(lanes_2d).at[dest].set(lanes_2d, mode="drop")
+    )
+    return compacted, new_lanes

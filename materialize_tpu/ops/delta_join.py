@@ -167,9 +167,15 @@ class DeltaJoinOp:
             self.arr_schemas.append(Schema(cols))
         self.n_parts = len(self.arr_specs)
 
-    def init_state(self, capacity: int = 256, tail_capacity: int = 1024) -> tuple:
+    def init_state(
+        self, capacity: int = 256, tail_capacity: int = 1024,
+        ingest_slots: int = 0,
+    ) -> tuple:
         return tuple(
-            Spine.empty(sch, key, capacity, tail_capacity)
+            Spine.empty(
+                sch, key, capacity, tail_capacity,
+                ingest_slots=ingest_slots,
+            )
             for (j, key), sch in zip(self.arr_specs, self.arr_schemas)
         )
 
@@ -203,7 +209,11 @@ class DeltaJoinOp:
             )
             outs.append(out)
             ovfs.append(ovf)
-        return concat_batches(outs), jnp.logical_or(*ovfs)
+        from functools import reduce
+
+        # One flag per run AND ingest slot (append-slot spines probe
+        # the slot ring too).
+        return concat_batches(outs), reduce(jnp.logical_or, ovfs)
 
     def _probe_run(self, acc: Batch, arr: Arrangement, probe_lanes,
                    out_time, out_capacity: int):

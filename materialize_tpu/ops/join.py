@@ -110,15 +110,18 @@ class JoinOp:
         )
         self.n_parts = 2
 
-    def init_state(self, capacity: int = 256, tail_capacity: int = 1024) -> tuple:
+    def init_state(
+        self, capacity: int = 256, tail_capacity: int = 1024,
+        ingest_slots: int = 0,
+    ) -> tuple:
         return (
             Spine.empty(
                 self.left_state_schema, self.left_key, capacity,
-                tail_capacity,
+                tail_capacity, ingest_slots=ingest_slots,
             ),
             Spine.empty(
                 self.right_state_schema, self.right_key, capacity,
-                tail_capacity,
+                tail_capacity, ingest_slots=ingest_slots,
             ),
         )
 
@@ -141,6 +144,8 @@ class JoinOp:
         column order. Probes both runs of the spine; a row value present
         in both runs (with cancelling diffs) yields matches from both,
         which downstream consolidation cancels — multiset semantics."""
+        from functools import reduce
+
         probe_lanes = spine.runs()[0].probe_lanes(delta, delta_key)
         outs, ovfs = [], []
         for arr in spine.runs():
@@ -150,7 +155,9 @@ class JoinOp:
             )
             outs.append(out)
             ovfs.append(ovf)
-        return concat_batches(outs), jnp.logical_or(*ovfs)
+        # One flag per run AND ingest slot (append-slot spines probe
+        # the slot ring too).
+        return concat_batches(outs), reduce(jnp.logical_or, ovfs)
 
     def _probe_run(
         self,

@@ -161,6 +161,17 @@ def key_lanes(batch: Batch, key_indices) -> list[jnp.ndarray]:
     return lanes
 
 
+def key_lane_width(schema, key_indices) -> int:
+    """Static lane count key_lanes emits for these columns — the prefix
+    width cached stacked sort lanes are sliced at for key-only
+    searches. A function of the schema alone (key_lanes contract)."""
+    w = sum(
+        lane_count(schema[i].ctype, schema[i].nullable)
+        for i in key_indices
+    )
+    return w if w else 1  # empty key: the single constant lane
+
+
 def row_lanes(batch: Batch, include_time: bool = True) -> list[jnp.ndarray]:
     """Lanes over every column (plus optionally time) — full-row identity,
     used by consolidation."""
@@ -170,16 +181,40 @@ def row_lanes(batch: Batch, include_time: bool = True) -> list[jnp.ndarray]:
     return lanes
 
 
+def _mix_lane(h: jnp.ndarray, lane: jnp.ndarray) -> jnp.ndarray:
+    """One sequential mixing stage of hash_lanes (shared by the unrolled
+    and the scan-fused forms — values must match bit-for-bit)."""
+    h = h ^ (
+        lane
+        + jnp.uint64(0x9E3779B97F4A7C15)
+        + (h << jnp.uint64(6))
+        + (h >> jnp.uint64(2))
+    )
+    h = h * jnp.uint64(0xBF58476D1CE4E5B9)
+    return h ^ (h >> jnp.uint64(27))
+
+
 def hash_lanes(lanes, seed: int = 0x9E3779B97F4A7C15) -> jnp.ndarray:
     """Mix lanes into a single uint64 hash (for exchange routing, not
     identity). Analog of the Exchange pact's key hash
     (timely columnar_exchange)."""
     h = jnp.full(lanes[0].shape, jnp.uint64(seed))
     for lane in lanes:
-        h = h ^ (lane + jnp.uint64(0x9E3779B97F4A7C15) + (h << jnp.uint64(6)) + (h >> jnp.uint64(2)))
-        h = h * jnp.uint64(0xBF58476D1CE4E5B9)
-        h = h ^ (h >> jnp.uint64(27))
+        h = _mix_lane(h, lane.astype(jnp.uint64))
     return h
+
+
+def stack_lanes(lanes) -> jnp.ndarray:
+    """Row-stack a lane tuple into one ``[cap, L]`` uint64 array — the
+    fused form every data-dependent lane movement wants (PERF_NOTES
+    design rule "move rows, not columns": a single row-gather fetches
+    every lane of a row, instead of one gather per lane)."""
+    return jnp.stack([l.astype(jnp.uint64) for l in lanes], axis=1)
+
+
+def unstack_lanes(stacked: jnp.ndarray) -> list:
+    """Inverse of stack_lanes (static unstack; slices fuse for free)."""
+    return [stacked[:, j] for j in range(stacked.shape[1])]
 
 
 # Second-stream seed for the hash-pair order (any odd constant distinct
@@ -197,7 +232,29 @@ def hash_pair(lanes) -> tuple[jnp.ndarray, jnp.ndarray]:
     EXACT everywhere: consumers compare full exact lanes on ADJACENT
     rows (cheap elementwise) — the hash pair only fixes a consistent
     total order, so a collision can at worst place two different rows
-    next to each other, never merge them."""
+    next to each other, never merge them.
+
+    Wide lane tuples run both mix chains as ONE lax.scan over the
+    stacked lanes (round-6 kernel-budget work): the unrolled form
+    emitted ~6 ops per lane per chain — ~300 eqns for a 25-lane row —
+    which dominated the step program's op census. Bit-identical to the
+    unrolled chains."""
+    if len(lanes) >= 4:
+        stacked = jnp.stack(
+            [l.astype(jnp.uint64) for l in lanes]
+        )  # [L, cap]
+        h0 = jnp.stack(
+            [
+                jnp.full(lanes[0].shape, jnp.uint64(0x9E3779B97F4A7C15)),
+                jnp.full(lanes[0].shape, jnp.uint64(_HASH2_SEED)),
+            ]
+        )
+
+        def body(h, lane):
+            return _mix_lane(h, lane[None, :]), None
+
+        h, _ = jax.lax.scan(body, h0, stacked)
+        return h[0], h[1]
     return hash_lanes(lanes), hash_lanes(lanes, seed=_HASH2_SEED)
 
 

@@ -14,6 +14,15 @@ TPU-native form (round-5 redesign, PERF_NOTES.md):
      per-index, independent of row width — rows2d.py).
 The old form scattered every field of both sides (30+ output-sized
 scatters; 8.3s at 2M rows). This form costs ~0.15s at the same shape.
+
+Round-6 fusion (`merge_sorted_cached`): lanes travel ROW-STACKED
+(``[cap, L]`` uint64 — PERF_NOTES design rule "move rows, not
+columns"), the binary search gathers one lane-row per iteration
+instead of one gather per lane (ops/search.lex_searchsorted_2d or the
+Pallas kernel, ops/merge_pallas.py, behind the ``fused_merge``
+dyncfg), and the merged run's lanes come out of the SAME src gather
+that moves the rows — so spine folds maintain their cached run lanes
+without ever re-hashing columns (arrangement/spine.py lane cache).
 """
 
 from __future__ import annotations
@@ -21,8 +30,10 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..repr.batch import Batch
+from ..utils.dyncfg import COMPUTE_CONFIGS, FUSED_MERGE
+from .lanes import stack_lanes
 from .rows2d import concat_groups, from_groups, gather_rows, to_groups
-from .search import lex_searchsorted
+from .search import lex_searchsorted, lex_searchsorted_2d
 
 
 def _normalize_nulls(a: Batch, b: Batch) -> tuple[Batch, Batch]:
@@ -41,21 +52,59 @@ def _normalize_nulls(a: Batch, b: Batch) -> tuple[Batch, Batch]:
     return widen(a, b), widen(b, a)
 
 
-def merge_sorted(
-    a: Batch,
-    a_lanes,
-    b: Batch,
-    b_lanes,
-    out_capacity: int,
-) -> tuple[Batch, jnp.ndarray]:
-    """Merge sorted `a` and `b` (same schema, each sorted by its lanes)
-    into one sorted batch of capacity `out_capacity`. Stable: ties keep
-    `a` rows first. Does NOT consolidate.
+def merge_insertion_points(
+    a_lanes_2d: jnp.ndarray, a_count, b_lanes_2d: jnp.ndarray, b_count
+) -> jnp.ndarray:
+    """Right-side insertion point of every b row among a's valid prefix
+    — the sorted-merge inner loop, implementation selected by the
+    ``fused_merge`` dyncfg (all choices agree bit-for-bit):
 
-    Returns (batch, overflowed): if a.count + b.count > out_capacity the
-    tail is dropped, count is clamped to out_capacity, and `overflowed`
-    is True — the host must retry at a larger capacity tier
-    (SURVEY.md §7 hard part #1)."""
+      'pallas'  — the VMEM-resident Pallas kernel (interpret mode
+                  off-TPU), when the shapes fit its budget;
+      'lax'     — fused binary search, one row-gather per iteration;
+      'auto'    — pallas on TPU when it fits, lax otherwise;
+      'unfused' — the legacy per-lane gather search (baseline).
+    """
+    mode = FUSED_MERGE(COMPUTE_CONFIGS)
+    if mode == "unfused":
+        from .lanes import unstack_lanes
+
+        return lex_searchsorted(
+            unstack_lanes(a_lanes_2d), a_count,
+            unstack_lanes(b_lanes_2d), side="right",
+        )
+    if mode in ("pallas", "auto"):
+        from .merge_pallas import pallas_available, pallas_search_right
+
+        if pallas_available(
+            a_lanes_2d.shape, b_lanes_2d.shape, force=(mode == "pallas")
+        ):
+            return pallas_search_right(
+                a_lanes_2d, a_count, b_lanes_2d, b_count
+            )
+    return lex_searchsorted_2d(
+        a_lanes_2d, a_count, b_lanes_2d, side="right"
+    )
+
+
+def merge_sorted_cached(
+    a: Batch,
+    a_lanes_2d: jnp.ndarray,
+    b: Batch,
+    b_lanes_2d: jnp.ndarray,
+    out_capacity: int,
+) -> tuple[Batch, jnp.ndarray, jnp.ndarray]:
+    """Merge sorted `a` and `b` (same schema, each sorted by its stacked
+    ``[cap, L]`` sort lanes) into one sorted batch of capacity
+    `out_capacity`, CARRYING THE LANES: the returned ``[out_capacity,
+    L]`` lane array is produced by the same src gather that moves the
+    rows, so callers holding cached run lanes never re-derive them from
+    columns. Stable: ties keep `a` rows first. Does NOT consolidate.
+
+    Returns (batch, lanes_2d, overflowed): if a.count + b.count >
+    out_capacity the tail is dropped, count is clamped, and
+    `overflowed` is True — the host must retry at a larger capacity
+    tier (SURVEY.md §7 hard part #1)."""
     # Positional type equality: column NAMES are documentation and may
     # legitimately differ across plan paths (e.g. a Let-bound reduce
     # named by HIR vs its MIR-lowered delta); operators are positional.
@@ -67,7 +116,9 @@ def merge_sorted(
     ib = jnp.arange(cap_b, dtype=jnp.int32)
     # Output position of each b row: its own rank + #{a rows before it}
     # (side='right': ties place a first — stable).
-    pos_b = ib + lex_searchsorted(a_lanes, a.count, b_lanes, side="right")
+    pos_b = ib + merge_insertion_points(
+        a_lanes_2d, a.count, b_lanes_2d, b.count
+    )
     pos_b = jnp.where(ib < b.count, pos_b, out_capacity)  # drop padding
 
     # Invert: mark b positions (small-side scatter), cumsum to count b
@@ -91,6 +142,7 @@ def merge_sorted(
     ga = to_groups(a)
     gb = to_groups(b)
     merged_groups = gather_rows(concat_groups(ga, gb), src)
+    merged_lanes = jnp.concatenate([a_lanes_2d, b_lanes_2d])[src]
 
     total = (a.count + b.count).astype(jnp.int32)
     overflowed = total > out_capacity
@@ -98,10 +150,33 @@ def merge_sorted(
     merged = from_groups(merged_groups, a, count)
     # Padding hygiene: the gather fills slots >= count with clamped
     # garbage rows; zero their diff/time (the old scatter form left
-    # zeros there, and diff-based consumers rely on it).
+    # zeros there, and diff-based consumers rely on it). Lane padding
+    # stays garbage — every lane consumer bounds itself by count.
     valid = j < count
     merged = merged.replace(
         diff=jnp.where(valid, merged.diff, 0),
         time=jnp.where(valid, merged.time, jnp.zeros_like(merged.time)),
+    )
+    return merged, merged_lanes, overflowed
+
+
+def merge_sorted(
+    a: Batch,
+    a_lanes,
+    b: Batch,
+    b_lanes,
+    out_capacity: int,
+) -> tuple[Batch, jnp.ndarray]:
+    """Lane-list compatibility wrapper over merge_sorted_cached (same
+    semantics; stacks the lane tuples and drops the carried lanes)."""
+    def as_2d(lanes):
+        return (
+            lanes
+            if getattr(lanes, "ndim", None) == 2
+            else stack_lanes(lanes)
+        )
+
+    merged, _, overflowed = merge_sorted_cached(
+        a, as_2d(a_lanes), b, as_2d(b_lanes), out_capacity
     )
     return merged, overflowed

@@ -1,0 +1,164 @@
+"""Pallas TPU kernel for the sorted-merge inner loop.
+
+The merge position computation (ops/merge.merge_insertion_points) is,
+per b row, the count of a rows lexicographically <= it. The pure-lax
+form is a vectorized binary search: log2(m) iterations, each issuing a
+row-gather plus compares — ~20 dependent device ops at run scale. This
+kernel computes the same insertion points as ONE fused kernel: a
+classic two-pointer sorted-merge sweep (O(m + n) scalar steps) over
+VMEM-resident lane rows, the shape a merge cursor takes on hardware
+where control flow is cheap only when it never leaves the core.
+
+Numerics: lanes are uint64 on the host side, but TPU has no native
+64-bit integers (PERF_NOTES fact 7) — callers pass lanes SPLIT into
+(hi, lo) uint32 pairs (``split_u64_lanes``), and the kernel compares
+the split rows lexicographically, which equals u64 lexicographic
+comparison exactly.
+
+Availability (``pallas_available``): the whole point is VMEM
+residency, so the kernel only volunteers (fused_merge='auto') on TPU
+backends when both lane arrays fit the VMEM budget; forcing
+(fused_merge='pallas') runs it anywhere via the interpreter so CPU
+tests exercise the exact TPU semantics (dyncfg contract in ISSUE 5).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Per-side byte budget for auto mode (~4 MiB of split-u32 lane rows:
+# rows * 2L * 4 bytes) — both sides comfortably VMEM-resident next to
+# the output. Row count alone is not enough: a wide exact-order schema
+# can carry 20+ u64 lanes (40+ u32 after the split), so the budget is
+# checked in BYTES. Beyond it the lax binary search wins anyway
+# (log m gathers vs an HBM-streaming sweep).
+AUTO_MAX_SIDE_BYTES = 4 << 20
+
+
+def _side_bytes(shape) -> int:
+    rows, L = shape
+    return rows * (2 * L) * 4
+
+
+def _pallas_modules():
+    from jax.experimental import pallas as pl
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:  # pragma: no cover - pallas without TPU support
+        pltpu = None
+    return pl, pltpu
+
+
+@functools.lru_cache(maxsize=1)
+def _pallas_importable() -> bool:
+    try:
+        _pallas_modules()
+        return True
+    except Exception:
+        return False
+
+
+def pallas_available(a_shape, b_shape, force: bool = False) -> bool:
+    """Whether the kernel should handle these lane shapes.
+
+    force=True (fused_merge='pallas'): anywhere pallas imports —
+    off-TPU it runs interpreted (slow, test-only).
+    force=False (auto): real TPU backends only, within the VMEM
+    budget."""
+    if not _pallas_importable():
+        return False
+    if force:
+        return True
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    return (
+        _side_bytes(a_shape) <= AUTO_MAX_SIDE_BYTES
+        and _side_bytes(b_shape) <= AUTO_MAX_SIDE_BYTES
+    )
+
+
+def split_u64_lanes(lanes_2d: jnp.ndarray) -> jnp.ndarray:
+    """``[n, L]`` uint64 -> ``[n, 2L]`` uint32 (hi, lo per lane),
+    preserving lexicographic order."""
+    hi = (lanes_2d >> jnp.uint64(32)).astype(jnp.uint32)
+    lo = lanes_2d.astype(jnp.uint32)
+    n, L = lanes_2d.shape
+    return jnp.stack([hi, lo], axis=2).reshape(n, 2 * L)
+
+
+def _merge_sweep_kernel(count_ref, a_ref, b_ref, out_ref):
+    """Two-pointer sweep: i walks a, j walks b; a row is consumed while
+    a[i] <= b[j] (ties consume a first — the merge's stability rule),
+    and when it no longer is, i IS b[j]'s right insertion point."""
+    a_count = count_ref[0, 0]
+    n = out_ref.shape[0]
+    width = a_ref.shape[1]
+
+    def lex_le(i, j):
+        """a[i] <= b[j], lexicographic over the split u32 lanes."""
+        lt = jnp.bool_(False)
+        eq = jnp.bool_(True)
+        for k in range(width):
+            av = a_ref[i, k]
+            bv = b_ref[j, k]
+            lt = jnp.logical_or(lt, jnp.logical_and(eq, av < bv))
+            eq = jnp.logical_and(eq, av == bv)
+        return jnp.logical_or(lt, eq)
+
+    def cond(carry):
+        _, j = carry
+        return j < n
+
+    def body(carry):
+        i, j = carry
+        consume_a = jnp.logical_and(i < a_count, lex_le(i, j))
+
+        def take_a(c):
+            return c[0] + 1, c[1]
+
+        def emit_b(c):
+            out_ref[c[1], 0] = c[0]
+            return c[0], c[1] + 1
+
+        return jax.lax.cond(consume_a, take_a, emit_b, (i, j))
+
+    jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.int32(0))
+    )
+
+
+def pallas_search_right(
+    a_lanes_2d: jnp.ndarray, a_count, b_lanes_2d: jnp.ndarray, b_count
+) -> jnp.ndarray:
+    """Right-side insertion points of b rows in a's valid prefix —
+    bit-identical to ``lex_searchsorted_2d(a, a_count, b, 'right')``.
+    Rows past ``b_count`` get arbitrary values (the merge masks them).
+    """
+    pl, pltpu = _pallas_modules()
+    a32 = split_u64_lanes(a_lanes_2d)
+    b32 = split_u64_lanes(b_lanes_2d)
+    n = b32.shape[0]
+    count = jnp.asarray(a_count, jnp.int32).reshape(1, 1)
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    if pltpu is None:
+        specs = [pl.BlockSpec(memory_space=pl.ANY)] * 3
+        out_spec = pl.BlockSpec(memory_space=pl.ANY)
+    else:
+        specs = [
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ]
+        out_spec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _merge_sweep_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.int32),
+        in_specs=specs,
+        out_specs=out_spec,
+        interpret=interpret,
+    )(count, a32, b32)
+    return out[:, 0]

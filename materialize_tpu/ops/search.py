@@ -64,3 +64,53 @@ def lex_searchsorted(
 
     lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
     return lo
+
+
+def _lex_less_rows(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Row-wise a < b on ``[n, L]`` stacked lane arrays
+    (lexicographic across the L axis)."""
+    n, L = a.shape
+    lt = jnp.zeros(n, dtype=bool)
+    eq = jnp.ones(n, dtype=bool)
+    for j in range(L):
+        lt = jnp.logical_or(lt, jnp.logical_and(eq, a[:, j] < b[:, j]))
+        eq = jnp.logical_and(eq, a[:, j] == b[:, j])
+    return lt
+
+
+def lex_searchsorted_2d(
+    sorted_2d: jnp.ndarray, count, query_2d: jnp.ndarray,
+    side: str = "left",
+) -> jnp.ndarray:
+    """lex_searchsorted over ROW-STACKED lanes (``[m, L]`` / ``[n, L]``
+    uint64) — the fused form (round-6): each binary-search iteration
+    issues ONE row-gather for all L lanes of the probed mid rows
+    (gather cost is per-index, independent of row width — rows2d.py),
+    instead of one gather per lane per iteration. Same insertion-point
+    semantics as lex_searchsorted."""
+    m, L = sorted_2d.shape
+    n = query_2d.shape[0]
+    assert query_2d.shape[1] == L, (sorted_2d.shape, query_2d.shape)
+    lo = jnp.zeros(n, dtype=jnp.int32)
+    hi = jnp.broadcast_to(jnp.asarray(count, dtype=jnp.int32), (n,))
+    steps = max(1, m.bit_length())
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = (lo + hi) // 2
+        mid_rows = sorted_2d[mid]  # one [n, L] row-gather
+        if side == "left":
+            go_right = _lex_less_rows(mid_rows, query_2d)
+        else:
+            go_right = jnp.logical_not(
+                _lex_less_rows(query_2d, mid_rows)
+            )
+        nonempty = lo < hi
+        lo = jnp.where(jnp.logical_and(nonempty, go_right), mid + 1, lo)
+        hi = jnp.where(
+            jnp.logical_and(nonempty, jnp.logical_not(go_right)), mid, hi
+        )
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    return lo

@@ -123,6 +123,10 @@ def shrink(batch: Batch, capacity: int):
         diff=sl(batch.diff),
         count=jnp.minimum(batch.count, capacity),
         schema=batch.schema,
+        # A prefix slice preserves every sortedness/uniqueness hint
+        # (the consolidate -> shrink -> arrangement-insert chain relies
+        # on the hint surviving to skip the insert-side re-sort).
+        hints=batch.hints,
     )
     return out, batch.count > capacity
 

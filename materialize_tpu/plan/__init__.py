@@ -6,6 +6,8 @@ plan enums (ReducePlan/TopKPlan/JoinPlan/ThresholdPlan).
 """
 
 from .decisions import (  # noqa: F401
+    INGEST_RING_SLOTS,
+    ingest_mode,
     join_implementation,
     join_stage_keys,
     monotonic,
@@ -13,6 +15,7 @@ from .decisions import (  # noqa: F401
     plan_reduce,
     plan_threshold,
     plan_topk,
+    state_ingest_mode,
 )
 from .lir import (  # noqa: F401
     JoinPlan,

@@ -19,6 +19,66 @@ from .lir import (
 )
 
 
+# Append-slot ring length: must cover every insert between level-0
+# folds (render/dataflow.py _check_slot_ring), so it tracks the
+# default compaction cadence (_DataflowBase._compact_every).
+INGEST_RING_SLOTS = 8
+
+
+def ingest_mode(
+    state_capacity: int, tail_capacity: int = 1024
+) -> str:
+    """Spine hot-path ingest decision (ISSUE 5 / DBSP discipline: pay
+    only for changes). 'append_slot': each arranged delta lands in a
+    run-0 append slot — O(delta) per step, with the geometric ladder's
+    level-0 fold absorbing the ring on its existing amortized cadence.
+    'merge': every step merges into run 0 — O(run0) per step, fine
+    while run 0 is delta-sized.
+
+    Auto rule: append-slot once the state tier is clearly past the
+    ingest tier (>= 8x), i.e. exactly when the per-step O(run0) merge
+    would start scaling with state instead of with the delta. Shared
+    by EXPLAIN and the render layer (single-source-of-truth contract
+    of this module). SPMD dataflows currently force 'merge': the slot
+    cursor is a replicated scalar that the shard_map boundary specs do
+    not carry (render/dataflow.py ShardedDataflow)."""
+    from ..utils.dyncfg import (
+        ARRANGEMENT_INGEST_MODE,
+        COMPUTE_CONFIGS,
+    )
+
+    mode = ARRANGEMENT_INGEST_MODE(COMPUTE_CONFIGS)
+    if mode != "auto":
+        return mode
+    return (
+        "append_slot"
+        if state_capacity >= 8 * tail_capacity
+        else "merge"
+    )
+
+
+def state_ingest_mode(state_capacity: int, tail_capacity: int = 1024) -> str:
+    """Ingest decision for OPERATOR-STATE spines (join/delta-join
+    arrangements). The dyncfg override is respected, but `auto`
+    resolves to 'merge' here for now: a slot ring per arrangement part
+    multiplies per-operator memory, and regrowing the ring through a
+    delta-join step program makes the CPU tier probe (bench.py
+    --reprobe) blow the driver's time budget — the exact failure mode
+    ISSUE 5's bench satellite removes. Flip the default to the
+    big-state rule (ingest_mode) once bench_tiers.json is regenerated
+    on a host that can afford the probe. The render layer and the
+    slotted-join tests exercise the append_slot path via the dyncfg."""
+    from ..utils.dyncfg import (
+        ARRANGEMENT_INGEST_MODE,
+        COMPUTE_CONFIGS,
+    )
+
+    mode = ARRANGEMENT_INGEST_MODE(COMPUTE_CONFIGS)
+    if mode != "auto":
+        return mode
+    return "merge"
+
+
 def plan_reduce(aggregates) -> ReducePlan:
     """Partition aggregates into accumulable vs hierarchical and pick
     the reduce plan (plan/reduce.rs:130 decision)."""

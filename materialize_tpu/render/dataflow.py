@@ -57,6 +57,7 @@ from ..ops.temporal import TemporalFilterOp, canonicalize_temporal
 from ..ops.threshold import ThresholdOp
 from ..ops.topk import TopKOp
 from ..ops.sort import concat_batches, shrink
+from ..parallel.compat import require_shard_map
 from ..parallel.exchange import exchange
 from ..parallel.mesh import WORKER_AXIS, worker_sharding
 from ..repr.batch import Batch, capacity_tier
@@ -98,6 +99,20 @@ class _RenderContext:
         # to skip the overflow->grow->recompile ladder (each rung is a
         # fresh XLA compile of the step program).
         self.state_cap = state_cap
+        # Ingest-mode decision for operator-state spines
+        # (plan/decisions.py state_ingest_mode, the EXPLAIN-visible
+        # source of truth): the number of append slots spine states
+        # are built with, 0 = merge ingest. SPMD forces merge — the
+        # slot cursor is a replicated scalar the shard_map boundary
+        # specs do not carry.
+        from ..plan.decisions import INGEST_RING_SLOTS, state_ingest_mode
+
+        self.ingest_slots = (
+            INGEST_RING_SLOTS
+            if num_shards == 1
+            and state_ingest_mode(state_cap) == "append_slot"
+            else 0
+        )
         self.slots: list[_StateSlot] = []
         self.operators: list = []  # parallel to slots: op configs
         self.num_shards = num_shards
@@ -459,7 +474,10 @@ def _build_join_delta(expr: mir.Join, ctx: _RenderContext):
     an all_to_all on the relevant key (the half_join exchange)."""
     schemas = [i.schema() for i in expr.inputs]
     op = DeltaJoinOp(tuple(schemas), expr.equivalences)
-    slot = ctx.new_slot(op, op.init_state(ctx.state_cap))
+    slot = ctx.new_slot(
+        op,
+        op.init_state(ctx.state_cap, ingest_slots=ctx.ingest_slots),
+    )
     jsite = ctx.new_join_site()
     inners = [_build(i, ctx) for i in expr.inputs]
     ex_sites = {}
@@ -522,7 +540,12 @@ def _build_join_linear(expr: mir.Join, ctx: _RenderContext):
         left_key, right_key, consumed = join_stage_keys(expr, offsets, i)
         all_consumed.update(consumed)
         op = JoinOp(acc_schema, schemas[i], left_key, right_key)
-        slot = ctx.new_slot(op, op.init_state(ctx.state_cap))
+        slot = ctx.new_slot(
+            op,
+            op.init_state(
+                ctx.state_cap, ingest_slots=ctx.ingest_slots
+            ),
+        )
         jsite = ctx.new_join_site()
         lsite = ctx.new_exchange_site()
         rsite = ctx.new_exchange_site()
@@ -1082,29 +1105,54 @@ class _DataflowBase:
     ) -> Arrangement:
         return arr.map_batches(lambda b: self._grow_batch(b, target))
 
+    @staticmethod
+    def _pad_lanes(lanes, new_cap: int):
+        """Zero-pad a cached ``[cap, L]`` lane array to a grown run
+        capacity. Pad rows' lanes are garbage either way (every lane
+        consumer bounds itself by the run's count), so no recompute."""
+        if lanes.shape[0] >= new_cap:
+            return lanes
+        return (
+            jnp.zeros((new_cap, lanes.shape[1]), lanes.dtype)
+            .at[: lanes.shape[0]]
+            .set(lanes)
+        )
+
     def _grow_spine(
         self, spine: Spine, which, target: int | None = None
     ) -> Spine:
         """Grow one run of a spine. `which` is a run index, or the
         aliases "base" (largest run) / "tail" (the ingest tier: the
-        slot ring when present, else run 0)."""
+        slot ring when present, else run 0). Cached lanes are padded
+        alongside their run."""
         if which == "tail" and spine.slots:
+            new_slots = tuple(
+                self._grow_batch(s, target) for s in spine.slots
+            )
+            slot_lanes = spine.slot_lanes
+            if slot_lanes:
+                slot_lanes = tuple(
+                    self._pad_lanes(l, nb.capacity)
+                    for l, nb in zip(slot_lanes, new_slots)
+                )
             return Spine(
                 spine.runs_b,
                 spine.key,
                 spine.order,
-                tuple(
-                    self._grow_batch(s, target) for s in spine.slots
-                ),
+                new_slots,
                 spine.cursor,
+                spine.lanes,
+                slot_lanes,
             )
         if which == "base":
             which = spine.levels - 1
         elif which == "tail":
             which = 0
-        return spine.with_run(
-            which, self._grow_batch(spine.runs_b[which], target)
-        )
+        grown = self._grow_batch(spine.runs_b[which], target)
+        lanes = None
+        if spine.lanes:
+            lanes = self._pad_lanes(spine.lanes[which], grown.capacity)
+        return spine.with_run(which, grown, lanes)
 
     def _check_slot_ring(self) -> None:
         """The append-slot ring must hold every insert between level-0
@@ -1684,34 +1732,67 @@ class _DataflowBase:
                 st, o, e, t = carry
                 # Only the spine's INGEST tier rides the inner scan
                 # carry (the slot ring + cursor when present, else run
-                # 0); every other run is chunk-invariant (the step
-                # never touches it) and rejoins only for the
-                # compaction.
+                # 0 — each WITH its cached lanes, which the insert
+                # rewrites every step); every other run (and its
+                # lanes) is chunk-invariant (the step never touches
+                # it) and rejoins only for the compaction.
                 if o.slots:
                     invariant = o.runs_b
+                    inv_lanes = o.lanes
 
-                    def rebuild(carried):
-                        slots, cursor = carried
-                        return Spine(
-                            invariant, o.key, o.order, slots, cursor
-                        )
+                    if o.lanes:
 
-                    def extract(sp):
-                        return (sp.slots, sp.cursor)
+                        def rebuild(carried):
+                            slots, slot_lanes, cursor = carried
+                            return Spine(
+                                invariant, o.key, o.order, slots,
+                                cursor, inv_lanes, slot_lanes,
+                            )
 
-                    carried0 = (o.slots, o.cursor)
+                        def extract(sp):
+                            return (sp.slots, sp.slot_lanes, sp.cursor)
+
+                        carried0 = (o.slots, o.slot_lanes, o.cursor)
+                    else:
+
+                        def rebuild(carried):
+                            slots, cursor = carried
+                            return Spine(
+                                invariant, o.key, o.order, slots, cursor
+                            )
+
+                        def extract(sp):
+                            return (sp.slots, sp.cursor)
+
+                        carried0 = (o.slots, o.cursor)
                 else:
                     invariant = o.runs_b[1:]
+                    inv_lanes = o.lanes[1:] if o.lanes else ()
 
-                    def rebuild(carried):
-                        return Spine(
-                            (carried,) + invariant, o.key, o.order
-                        )
+                    if o.lanes:
 
-                    def extract(sp):
-                        return sp.runs_b[0]
+                        def rebuild(carried):
+                            r0, l0 = carried
+                            return Spine(
+                                (r0,) + invariant, o.key, o.order,
+                                (), None, (l0,) + inv_lanes, (),
+                            )
 
-                    carried0 = o.runs_b[0]
+                        def extract(sp):
+                            return (sp.runs_b[0], sp.lanes[0])
+
+                        carried0 = (o.runs_b[0], o.lanes[0])
+                    else:
+
+                        def rebuild(carried):
+                            return Spine(
+                                (carried,) + invariant, o.key, o.order
+                            )
+
+                        def extract(sp):
+                            return sp.runs_b[0]
+
+                        carried0 = o.runs_b[0]
 
                 def step_body(c2, x):
                     st2, ingest, e2, t2 = c2
@@ -1880,7 +1961,7 @@ class Dataflow(_DataflowBase):
 
     def __init__(self, expr: mir.RelationExpr, name: str = "df",
                  state_cap: int = 256, out_levels: int = 2,
-                 out_slots: int = 0):
+                 out_slots: int | None = None):
         from ..expr import strings
 
         self.expr = expr
@@ -1888,6 +1969,18 @@ class Dataflow(_DataflowBase):
         self.out_schema = expr.schema()
         self._str_keys, self._str_depth = strings.collect_keys(expr)
         ctx = _RenderContext({}, state_cap=state_cap)
+        if out_slots is None:
+            # Ingest-mode decision for the output index (plan layer —
+            # same source of truth EXPLAIN prints): append-slot ring
+            # for big-state outputs, every-step run-0 merge otherwise.
+            from ..plan.decisions import INGEST_RING_SLOTS, ingest_mode
+
+            out_slots = (
+                INGEST_RING_SLOTS
+                if ingest_mode(state_cap, ctx.out_delta_cap)
+                == "append_slot"
+                else 0
+            )
         self._run = _build(expr, ctx)
         self._ctx = ctx
         self._basic_finalizers = _resolve_basic_sites(expr, ctx)
@@ -2206,11 +2299,12 @@ class ShardedDataflow(_DataflowBase):
             with strings.trace_scope(env if env is not None else {}):
                 return body(states, output, err_output, inputs, time)
 
+        shard_map = require_shard_map()
         if self._str_keys:
             # env (the string side-tables) rides along REPLICATED: every
             # worker gathers through identical dictionaries
             def step(states, output, err_output, inputs, time, env):
-                return jax.shard_map(
+                return shard_map(
                     per_worker,
                     mesh=self.mesh,
                     in_specs=(P(self.axis_name), P(self.axis_name),
@@ -2223,7 +2317,7 @@ class ShardedDataflow(_DataflowBase):
                 )(states, output, err_output, inputs, time, env)
         else:
             def step(states, output, err_output, inputs, time):
-                return jax.shard_map(
+                return shard_map(
                     lambda s, o, eo, i, t: per_worker(s, o, eo, i, t),
                     mesh=self.mesh,
                     in_specs=(P(self.axis_name), P(self.axis_name),
@@ -2262,8 +2356,10 @@ class ShardedDataflow(_DataflowBase):
             )
             return new_states, new_out, fl
 
+        shard_map = require_shard_map()
+
         def compact(states, output):
-            return jax.shard_map(
+            return shard_map(
                 per_worker,
                 mesh=self.mesh,
                 in_specs=(P(self.axis_name), P(self.axis_name)),

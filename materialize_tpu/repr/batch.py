@@ -222,6 +222,16 @@ class Batch:
                 nulls.append(None)
         return self.replace(nulls=tuple(nulls))
 
+    # replace() fields that can never invalidate a sortedness hint:
+    # hints claim facts about row CONTENT order/uniqueness (and, for
+    # "hash_sorted", times), so swapping cols/nulls/time voids them,
+    # while diff (sign flips keep nonzero), count, schema rebrands
+    # (same content, new names), and explicit hints do not. Dropping
+    # by default here is what keeps the hint-consuming fast paths
+    # (ops/consolidate.py, spine._arrange_for_run) sound without every
+    # content-changing call site having to remember to launder.
+    _HINT_SAFE_FIELDS = frozenset({"diff", "count", "schema", "hints"})
+
     def replace(self, **kw) -> "Batch":
         d = dict(
             cols=self.cols,
@@ -232,5 +242,7 @@ class Batch:
             schema=self.schema,
             hints=self.hints,
         )
+        if not self._HINT_SAFE_FIELDS.issuperset(kw):
+            d["hints"] = ()
         d.update(kw)
         return Batch(**d)

@@ -111,6 +111,33 @@ OPTIMIZER_TYPECHECK = Config(
     "the test suite via tests/conftest.py",
 ).register(COMPUTE_CONFIGS)
 
+FUSED_MERGE = Config(
+    "fused_merge", "auto",
+    "sorted-merge position kernel: 'auto' picks the Pallas kernel on "
+    "TPU when both runs' lanes fit VMEM and the pure-lax fused binary "
+    "search elsewhere; 'lax' forces the fused lax path; 'pallas' "
+    "forces the Pallas kernel (interpret mode off-TPU — CPU tests and "
+    "the TPU path share semantics); 'unfused' keeps the legacy "
+    "per-lane gather search (comparison baseline)",
+).register(COMPUTE_CONFIGS)
+
+CACHED_RUN_LANES = Config(
+    "cached_run_lanes", True,
+    "carry each frozen spine run's stacked sort lanes in the spine "
+    "state, computed at fold time and maintained by the merge's own "
+    "row-gather — per-step probes and folds then never re-derive "
+    "lanes from columns of unchanged runs (round-6 O(delta) work)",
+).register(COMPUTE_CONFIGS)
+
+ARRANGEMENT_INGEST_MODE = Config(
+    "arrangement_ingest_mode", "auto",
+    "spine hot-path ingest: 'append_slot' lands each arranged delta "
+    "in a run-0 append slot (O(delta) per step; the ladder's level-0 "
+    "fold absorbs the ring on its amortized cadence), 'merge' merges "
+    "into run 0 every step (O(run0)); 'auto' picks append_slot for "
+    "big-state arrangements (plan/decisions.ingest_mode)",
+).register(COMPUTE_CONFIGS)
+
 COMPUTE_RETAIN_HISTORY = Config(
     "compute_retain_history", 32,
     "multiversion window: per-dataflow output-delta history retained "

@@ -171,27 +171,103 @@ def run_slt_mode(paths: list[str], verbose: bool) -> int:
     return 0
 
 
-def run_bench_mode(verbose: bool) -> int:
-    """Jaxpr-lint the standard bench dataflows (abstract tracing only —
-    nothing compiles)."""
-    from materialize_tpu.analysis import lint_dataflow
+BUDGET_PATH = os.path.join(REPO, "tests", "kernel_budget.json")
+
+
+def bench_dataflows() -> dict:
+    """name -> Dataflow factory for the budget-gated bench configs —
+    pure renders, no generators (CI must not pay TPCH data
+    generation). The index entry reproduces bench.config_index's
+    output-spine geometry (4-level ladder + 4-slot append ring); op
+    census is capacity-independent, so the init-tier capacities are
+    fine."""
+    from materialize_tpu.expr import relation as mir
     from materialize_tpu.render.dataflow import Dataflow
+    from materialize_tpu.storage.generator.tpch import LINEITEM_SCHEMA
     from materialize_tpu.transform.optimizer import optimize
-    from materialize_tpu.utils.dyncfg import COMPUTE_CONFIGS
     from materialize_tpu.workloads.tpch import q1_mir, q15_mir
 
+    return {
+        "index": lambda: Dataflow(
+            mir.Get("lineitem", LINEITEM_SCHEMA), name="index",
+            out_levels=4, out_slots=4,
+        ),
+        "q1": lambda: Dataflow(optimize(q1_mir()), name="q1"),
+        "q15": lambda: Dataflow(optimize(q15_mir()), name="q15"),
+    }
+
+
+def run_bench_mode(verbose: bool) -> int:
+    """Jaxpr-lint the standard bench dataflows AND gate their step
+    programs' op census against the checked-in kernel budgets
+    (tests/kernel_budget.json) — a launch-count regression fails CI
+    statically, before any hardware run (abstract tracing only;
+    nothing compiles)."""
+    import json
+
+    from materialize_tpu.analysis import (
+        kernel_count,
+        lint_jaxpr,
+        trace_dataflow_step,
+    )
+    from materialize_tpu.utils.dyncfg import COMPUTE_CONFIGS
+
     COMPUTE_CONFIGS.update({"optimizer_typecheck": True})
+    budgets = {}
+    if os.path.exists(BUDGET_PATH):
+        with open(BUDGET_PATH) as f:
+            budgets = json.load(f)
     rc = 0
-    for name, mk in (("q1", q1_mir), ("q15", q15_mir)):
-        df = Dataflow(optimize(mk()), name=name)
-        findings = lint_dataflow(df)
-        if findings:
+    from materialize_tpu.analysis.jaxpr_lint import _carry_finding
+
+    for name, mk in bench_dataflows().items():
+        df = mk()
+        # One abstract trace feeds both the linter and the census
+        # (tracing a TPCH step program costs seconds per config). A
+        # trace-time carry mismatch must still surface as the curated
+        # CARRY_VARY finding, not a crash that skips later configs.
+        try:
+            closed = trace_dataflow_step(df)
+        except TypeError as e:
+            findings = _carry_finding(e)
+            if findings is None:
+                raise
+            closed, n_ops = None, None
+        else:
+            findings = lint_jaxpr(closed)
+            n_ops = kernel_count(closed)
+        budget = budgets.get(name)
+        over = (
+            budget is not None
+            and n_ops is not None
+            and n_ops > budget
+        )
+        if findings or over:
             rc = 1
-            print(f"{name}: {len(findings)} finding(s)")
+            ops_desc = (
+                f"{n_ops} ops"
+                if n_ops is not None
+                else "trace failed, census unavailable"
+            )
+            print(
+                f"{name}: {len(findings)} finding(s), "
+                f"{ops_desc} (budget {budget})"
+            )
             for f in findings:
                 print(f"  {f}")
+            if over:
+                print(
+                    f"  [kernel-budget] step program has {n_ops} ops, "
+                    f"budget is {budget} (tests/kernel_budget.json): "
+                    "a change re-grew the per-step launch count. "
+                    "Either fuse the regression away or consciously "
+                    "raise the budget in the same PR."
+                )
         else:
-            print(f"{name}: clean")
+            print(
+                f"{name}: clean, {n_ops} ops"
+                + (f" (budget {budget})" if budget is not None else "")
+            )
     return rc
 
 

@@ -251,6 +251,10 @@ class TestSpmdReplica:
         """A replica whose data plane runs SPMD over a 4-device mesh
         (shard_map + all_to_all exchange) serves the same results as a
         single-device one, through the full controller + persist path."""
+        from materialize_tpu.parallel import compat as _compat
+
+        if not _compat.HAS_SHARD_MAP:
+            pytest.skip(_compat.MISSING_REASON)
         port = _free_port()
         loc = PersistLocation(
             str(tmp_path / "blob"), str(tmp_path / "consensus.db")

@@ -164,3 +164,61 @@ def test_stateful_operators_trace_clean():
     )
     df = _mk_dataflow(e)
     assert lint_dataflow(df) == []
+
+
+# -- kernel budget gate (round 6): launch-count regressions fail CI ----------
+
+
+def test_bench_kernel_budgets_hold():
+    """The step programs of the budget-gated bench configs must stay
+    within tests/kernel_budget.json — the static guard behind ISSUE
+    5's acceptance criterion (index step ops reduced >=2x vs the
+    pre-fusion main, which measured 1193)."""
+    import json
+    import os
+
+    from materialize_tpu.analysis import (
+        kernel_count,
+        trace_dataflow_step,
+    )
+
+    sys_path_repo = os.path.dirname(os.path.dirname(__file__))
+    import sys
+
+    scripts_dir = os.path.join(sys_path_repo, "scripts")
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    import check_plans
+
+    with open(
+        os.path.join(sys_path_repo, "tests", "kernel_budget.json")
+    ) as f:
+        budgets = json.load(f)
+    measured = {}
+    for name, mk in check_plans.bench_dataflows().items():
+        measured[name] = kernel_count(trace_dataflow_step(mk()))
+        assert measured[name] <= budgets[name], (
+            f"{name} step program grew to {measured[name]} ops "
+            f"(budget {budgets[name]}): fuse the regression away or "
+            "consciously raise tests/kernel_budget.json in this PR"
+        )
+    # The headline acceptance number stays pinned: the index step
+    # program must remain at least 2x leaner than pre-fusion main.
+    assert measured["index"] * 2 <= 1193, measured
+
+
+def test_index_budget_is_2x_under_prefusion_main():
+    """The checked-in index budget itself (not just the measurement)
+    keeps the >=2x reduction locked in."""
+    import json
+    import os
+
+    with open(
+        os.path.join(
+            os.path.dirname(os.path.dirname(__file__)),
+            "tests",
+            "kernel_budget.json",
+        )
+    ) as f:
+        budgets = json.load(f)
+    assert budgets["index"] * 2 <= 1193

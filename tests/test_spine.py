@@ -164,13 +164,20 @@ def test_join_dataflow_large_state_amortized():
         rb = right_batch(right_rows if t == 0 else [], t)
         df.run_steps([{"L": lb, "R": rb}])
 
-    # Hot-path insert is tail-sized: the join state spine's tail tier
-    # stayed ≪ the base tier that holds the ~20k rows.
+    # Hot-path insert is ingest-tier-sized: the join state spine's
+    # per-step insert target (the append-slot ring at this state tier
+    # — plan/decisions.ingest_mode — else run 0) stayed ≪ the base
+    # tier that holds the ~20k rows.
     spine_l = df.states[0][0]
     assert int(np.asarray(spine_l.base.count)) + int(
         np.asarray(spine_l.tail.count)
     ) >= len(oracle_l)
-    assert spine_l.tail_capacity < spine_l.capacity
+    ingest_cap = (
+        spine_l.slots[0].capacity
+        if spine_l.slots
+        else spine_l.tail_capacity
+    )
+    assert ingest_cap < spine_l.capacity
 
     got = {}
     for r in df.peek():
