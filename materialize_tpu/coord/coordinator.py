@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time as _time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -117,6 +118,17 @@ class Coordinator:
         )
         self._item_seq = 0
         self._transient_seq = 0
+        # Slow-path SELECT memoization (ISSUE 6 satellite): description
+        # fingerprint -> installed transient dataflow name, LRU-capped
+        # by the transient_peek_cache dyncfg. Flushed on DROP (a cached
+        # transient's index imports would otherwise block DROP INDEX on
+        # its publisher) and on dictionary rebalance (its expr codes go
+        # stale).
+        self._transient_cache: dict = {}
+        # Serving-mode timestamp-selection cache (peek_ts_cache_ms):
+        # df name -> (as_of, monotonic stamp, write epoch).
+        self._ts_cache: dict = {}
+        self._write_epoch = 0
         # Net durable effects of the CURRENT statement (appends minus
         # retractions): the DictExhausted replan-retry in execute() is
         # only safe when the failed attempt left no net durable state.
@@ -241,6 +253,10 @@ class Coordinator:
                 # a completed table write cannot be undone -> re-raise).
                 if self._net_durable != before:
                     raise
+                # Cached transient dataflows hold exprs labeled under
+                # the OLD dictionary: their fingerprints go stale and
+                # must not serve post-rebalance replans.
+                self._flush_transient_peeks()
                 GLOBAL_DICT.rebalance()
                 plan = plan_statement(sql, self.catalog)
                 return self._sequence(plan, sql=sql)
@@ -586,6 +602,7 @@ class Coordinator:
                 norm.append(tuple(r))
             if not norm:
                 return 0
+            self._write_epoch += 1
             cols, nulls = self._encode_insert(it.schema, norm)
             t = w.upper
             w.compare_and_append(
@@ -689,6 +706,7 @@ class Coordinator:
         same upper with empty appends, then apply the write to the
         oracle. The ONE place the table-timeline protocol lives."""
         self._net_durable += 1
+        self._write_epoch += 1  # invalidate cached peek timestamps
         at_least = max(
             (w.upper for w in self._table_writers.values()), default=0
         )
@@ -723,11 +741,57 @@ class Coordinator:
     ):
         """Install a transient dataflow, peek it at the sources' latest
         complete time (or exactly ``as_of`` when given: AS OF hydrates
-        the dataflow at t — inputs must be readable there), drop it;
-        returns raw (vals..., time, diff) rows. ``unlocked`` releases
-        the sequencing lock during the wait — safe for SELECT, NOT for
-        DML whose read must be atomic with its write."""
+        the dataflow at t — inputs must be readable there); returns raw
+        (vals..., time, diff) rows. ``unlocked`` releases the
+        sequencing lock during the wait — safe for SELECT, NOT for DML
+        whose read must be atomic with its write.
+
+        SELECT-path installs are MEMOIZED by description fingerprint
+        (the PR 1 fingerprint-stability work exists for exactly this):
+        a repeated identical SELECT reuses the still-installed (and
+        still-maintained) transient dataflow — no re-render, no
+        re-compile, just a fresh timestamp selection + peek. The cache
+        is LRU-capped (transient_peek_cache dyncfg); evicted and
+        non-memoized installs drop as before."""
+        from ..utils.dyncfg import TRANSIENT_PEEK_CACHE
+
         imports, index_imports = self._source_imports(expr)
+        cap = int(TRANSIENT_PEEK_CACHE(COMPUTE_CONFIGS))
+        key = None
+        if unlocked and cap > 0:
+            import pickle as _pickle
+
+            key = _pickle.dumps(
+                (
+                    expr,
+                    sorted(imports.items()),
+                    sorted(index_imports.items()),
+                    as_of,
+                ),
+                protocol=_pickle.HIGHEST_PROTOCOL,
+            )
+            hit = self._transient_cache.get(key)
+            if hit is not None:
+                name, _deps = hit
+                # LRU touch (dict preserves insertion order).
+                self._transient_cache[key] = self._transient_cache.pop(
+                    key
+                )
+                try:
+                    return self._peek_transient(name, as_of, unlocked)
+                except Exception:
+                    # The replica lost it (restart, drop race) or the
+                    # peek failed against the cached install: forget
+                    # it and fall through to a fresh install, which
+                    # surfaces any real error to the user. The drop
+                    # broadcast itself may fail against the same dead
+                    # replica — that must not preempt the retry.
+                    self._transient_cache.pop(key, None)
+                    self._deregister_dataflow(name)
+                    try:
+                        self.controller.drop_dataflow(name)
+                    except Exception:
+                        pass
         self._transient_seq += 1
         name = f"t{self._transient_seq}"
         self._register_dataflow(
@@ -739,25 +803,24 @@ class Coordinator:
             unlocked=unlocked,
             durable=False,
         )
+        if key is not None:
+            deps = (
+                set(imports)
+                | set(index_imports)
+                | {pub for pub, _ in index_imports.values()}
+            )
+            self._transient_cache[key] = (name, deps)
+            while len(self._transient_cache) > cap:
+                old_key = next(iter(self._transient_cache))
+                old, _deps = self._transient_cache.pop(old_key)
+                self._deregister_dataflow(old)
+                try:
+                    self.controller.drop_dataflow(old)
+                except Exception:
+                    pass
+            return self._peek_transient(name, as_of, unlocked)
         try:
-            if as_of is not None:
-                as_of_sel, exact = as_of, True
-            else:
-                as_of_sel = self._select_timestamp_shards(
-                    self._df_upstream.get(name, [])
-                )
-                exact = False
-            if unlocked:
-                with self._unlocked():
-                    rows, _ = self.controller.peek(
-                        name, as_of=as_of_sel, timeout=PEEK_TIMEOUT,
-                        exact=exact,
-                    )
-            else:
-                rows, _ = self.controller.peek(
-                    name, as_of=as_of_sel, timeout=PEEK_TIMEOUT,
-                    exact=exact,
-                )
+            return self._peek_transient(name, as_of, unlocked)
         finally:
             # Deregister FIRST: the dict pops cannot fail, while
             # drop_dataflow's broadcast can (dead replica socket) — a
@@ -765,7 +828,52 @@ class Coordinator:
             # blocking DROP INDEX on the publisher forever.
             self._deregister_dataflow(name)
             self.controller.drop_dataflow(name)
+
+    def _peek_transient(
+        self, name: str, as_of: int | None, unlocked: bool
+    ):
+        """Timestamp-select + peek an installed transient dataflow."""
+        if as_of is not None:
+            as_of_sel, exact = as_of, True
+        else:
+            as_of_sel = self._select_timestamp_shards(
+                self._df_upstream.get(name, [])
+            )
+            exact = False
+        if unlocked:
+            with self._unlocked():
+                rows, _ = self.controller.peek(
+                    name, as_of=as_of_sel, timeout=PEEK_TIMEOUT,
+                    exact=exact,
+                )
+        else:
+            rows, _ = self.controller.peek(
+                name, as_of=as_of_sel, timeout=PEEK_TIMEOUT,
+                exact=exact,
+            )
         return rows
+
+    def _flush_transient_peeks(self, doomed: set | None = None) -> None:
+        """Drop memoized transient dataflows — all of them (dictionary
+        rebalance: stale codes; shutdown), or with ``doomed`` only the
+        entries whose imports reference a dropped object (a cached
+        transient's index imports would otherwise block DROP INDEX on
+        its publisher; unrelated cached SELECTs keep their installs)."""
+        if doomed is None:
+            cache, self._transient_cache = self._transient_cache, {}
+            victims = list(cache.values())
+        else:
+            victims = []
+            for k in list(self._transient_cache):
+                name, deps = self._transient_cache[k]
+                if deps & doomed:
+                    victims.append(self._transient_cache.pop(k))
+        for name, _deps in victims:
+            self._deregister_dataflow(name)
+            try:
+                self.controller.drop_dataflow(name)
+            except Exception:
+                pass
 
     def _read_rows_multiset(self, expr: mir.RelationExpr) -> dict:
         """The read half of DELETE/UPDATE's read-then-write: runs UNDER
@@ -1133,6 +1241,12 @@ class Coordinator:
             src = self.sources.get(name)
             if src is not None:
                 doomed.update(src.adapter.subsources)
+        # Memoized transient SELECT dataflows importing the dropped
+        # object would block the DROP (importer bookkeeping): flush
+        # exactly those entries before the checks below; unrelated
+        # cached SELECTs keep their installs.
+        self._flush_transient_peeks(doomed=doomed)
+        self._ts_cache.clear()
         deps = [d for d in self._dependents(doomed) if d not in doomed]
         if deps:
             raise PlanError(
@@ -1255,14 +1369,28 @@ class Coordinator:
         expr = optimize(self._inline_views(plan.expr))
         if self._introspection_names(expr) is not None:
             return self._sequence_introspection_peek(plan, expr)
-        # Fast path (peek.rs fast-path detection): a bare Get of a
-        # peekable (indexed / materialized) relation. Timestamp
+        as_of_req = getattr(plan, "as_of", None)
+        # O(result) fast path (ISSUE 6 / coord/peek.py): a key-equality
+        # lookup or full scan over a peekable relation row-gathers
+        # straight from the maintained spine — no transient dataflow,
+        # no render, batched with concurrent sessions' lookups into one
+        # device gather. AS OF reads keep the multiversion peek path.
+        from ..utils.dyncfg import PEEK_FAST_PATH
+
+        if as_of_req is None and PEEK_FAST_PATH(COMPUTE_CONFIGS):
+            from ..plan.decisions import peek_fast_path
+
+            dec = peek_fast_path(expr, frozenset(self.peekable))
+            if dec is not None and not self._peek_has_basic(dec.name):
+                return self._sequence_fast_peek(plan, expr, dec)
+        # Peekable bare Get (peek.rs fast-path detection): serve the
+        # maintained dataflow's full result via the ordinary peek
+        # protocol (the AS OF / fast-path-disabled route). Timestamp
         # selection (coord/timestamp_selection.rs): read at the latest
         # complete time of the UPSTREAM SOURCES, waiting for the
         # dataflow's frontier to pass it (freshness: the read is
         # linearizable w.r.t. ingested data, not merely whatever the
         # dataflow happens to have processed).
-        as_of_req = getattr(plan, "as_of", None)
         if isinstance(expr, mir.Get) and expr.name in self.peekable:
             df = self.peekable[expr.name]
             if as_of_req is not None:
@@ -1298,6 +1426,140 @@ class Coordinator:
             columns=plan.column_names,
             schema=expr.schema(),
         )
+
+    # -- the O(result) fast path (coord/peek.py serving plane) ---------------
+    def _peek_has_basic(self, name: str) -> bool:
+        """Basic-aggregate (string_agg/array_agg/list_agg) outputs carry
+        opaque digests in the maintained arrangement; only the serving
+        dataflow's own edge finalization can materialize them, so such
+        relations keep the ordinary peek path."""
+        it = self.catalog.items.get(name)
+        if it is None:
+            return False
+        if it.kind == "materialized-view":
+            return _has_basic_aggs(it.definition["expr"], self.catalog)
+        if it.kind == "view":
+            return _has_basic_aggs(it.definition, self.catalog)
+        return False
+
+    def _select_peek_timestamp(self, df: str) -> int:
+        """Timestamp selection for a fast-path read, with an optional
+        serving-mode cache (peek_ts_cache_ms): under concurrency, reads
+        within one serving tick share a selected timestamp instead of
+        each paying a consensus read — invalidated by any write through
+        this coordinator, so read-your-writes holds; staleness w.r.t.
+        out-of-band source ticks is bounded by the window."""
+        from ..utils.dyncfg import PEEK_TS_CACHE_MS
+
+        ttl = float(PEEK_TS_CACHE_MS(COMPUTE_CONFIGS)) / 1000.0
+        if ttl > 0:
+            hit = self._ts_cache.get(df)
+            if (
+                hit is not None
+                and hit[2] == self._write_epoch
+                and _time.monotonic() - hit[1] < ttl
+            ):
+                return hit[0]
+        as_of = self._select_timestamp_shards(
+            self._df_upstream.get(df, [])
+        )
+        if ttl > 0:
+            self._ts_cache[df] = (
+                as_of, _time.monotonic(), self._write_epoch
+            )
+        return as_of
+
+    def _fast_peek_rows(self, dec) -> list:
+        """Raw (vals..., time, diff) rows for a fast-path decision:
+        timestamp-select, then one batched lookup through the
+        controller's read plane (the sequencing lock is released for
+        the wait — and for the ServerBusy shed, which must never poison
+        subsequent statements)."""
+        if dec.kind == "empty":
+            return []
+        df = self.peekable[dec.name]
+        as_of = self._select_peek_timestamp(df)
+        bound_cols = tuple(c for c, _ in dec.bound)
+        probe = tuple(lit.value for _, lit in dec.bound)
+        with self._unlocked():
+            rows, _served = self.controller.peek_lookup(
+                df,
+                bound_cols,
+                dec.kind == "scan",
+                probe,
+                as_of,
+                timeout=PEEK_TIMEOUT,
+            )
+        return rows
+
+    def _sequence_fast_peek(self, plan, expr, dec) -> ExecuteResult:
+        rows = self._fast_peek_rows(dec)
+        if dec.projection is not None:
+            rows = [
+                tuple(r[c] for c in dec.projection) + r[-2:]
+                for r in rows
+            ]
+        return ExecuteResult(
+            "rows",
+            rows=_finish(rows, plan.order_by,
+                         getattr(plan, "limit", None),
+                         getattr(plan, "offset", 0)),
+            columns=plan.column_names,
+            schema=expr.schema(),
+        )
+
+    def fast_peek_values(
+        self, name: str, values: tuple, bound_cols: tuple | None = None
+    ) -> list:
+        """Programmatic point lookup over a peekable relation — the
+        serving-plane API bench.py --serve and tests drive (the SQL
+        front end reaches the same plane through _sequence_peek; this
+        entry point skips parsing/planning, like a prepared statement
+        with bound parameters). ``values`` are user-space; ``bound_cols``
+        defaults to the leading columns. Returns finished result rows."""
+        with self._lock:
+            if name not in self.peekable:
+                raise PlanError(f"{name!r} is not peekable")
+            it = self.catalog.items[name]
+            cols = tuple(
+                bound_cols
+                if bound_cols is not None
+                else range(len(values))
+            )
+            probe = tuple(
+                self._encode_probe(it.schema.columns[c], v)
+                for c, v in zip(cols, values)
+            )
+            df = self.peekable[name]
+            as_of = self._select_peek_timestamp(df)
+        # Dispatch + wait WITHOUT the sequencing lock (the _unlocked
+        # dance would re-acquire just to release again): everything
+        # the read needs was resolved above.
+        rows, _ = self.controller.peek_lookup(
+            df, cols, False, probe, as_of, timeout=PEEK_TIMEOUT
+        )
+        return _finish(rows)
+
+    def _encode_probe(self, col: Column, v):
+        """User-space probe value -> internal representation (exactly
+        _encode_insert's per-value rule, so probes compare raw against
+        maintained columns)."""
+        if v is None:
+            raise PlanError("NULL never matches an equality lookup")
+        # Column-type checks FIRST (an int probe against a TEXT/BOOL
+        # column must still dictionary-encode/coerce, exactly like
+        # _encode_insert); the plain-numeric tail skips the temporal
+        # coercion helper, whose per-call imports cost real time at
+        # thousands of lookups per second.
+        if col.ctype is ColumnType.STRING:
+            return GLOBAL_DICT.encode(str(v))
+        if col.ctype is ColumnType.DECIMAL:
+            return round(float(v) * 10**col.scale)
+        if col.ctype is ColumnType.BOOL:
+            return bool(v)
+        if type(v) is int or type(v) is float:
+            return v
+        return self._temporal_to_int(v, col)
 
     def _register_dataflow(
         self, desc: DataflowDescription, unlocked: bool = True,
@@ -1382,6 +1644,7 @@ class Coordinator:
         self.controller.update_configuration(dict(values))
 
     def shutdown(self) -> None:
+        self._flush_transient_peeks()
         for sub in list(self.subscriptions.values()):
             sub.close()
         for src in self.sources.values():
@@ -1478,6 +1741,16 @@ def _finish(rows: list, order_by: tuple = (), limit=None,
     (RowSetFinishing application, coord/peek.rs:910). Without an ORDER
     BY, rows sort by full value for determinism; NULLs sort first (ASC)
     as in the reference's Datum ordering."""
+    # Point-lookup fast path: one row, multiplicity one — nothing to
+    # collapse or sort (the serving plane's hottest result shape).
+    if (
+        len(rows) == 1
+        and not order_by
+        and not offset
+        and limit is None
+        and rows[0][-1] == 1
+    ):
+        return [rows[0][:-2]]
     acc: dict = {}
     for r in rows:
         acc[r[:-2]] = acc.get(r[:-2], 0) + r[-1]

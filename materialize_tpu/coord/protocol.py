@@ -159,6 +159,21 @@ def peek(
     }
 
 
+def peek_lookup(
+    peek_id: int, dataflow: str, as_of: int | None, spec: dict
+) -> dict:
+    """A BATCHED fast-path peek (coord/peek.py): ``spec`` carries
+    {"scan": bool, "bound_cols": tuple, "probes": [...]} — N sessions'
+    stacked lookups against one maintained index, served by a single
+    device gather once the dataflow's frontier passes ``as_of``. The
+    response's ``rows_groups`` aligns with ``probes`` (one shared group
+    for scans)."""
+    return {
+        "kind": "Peek", "peek_id": peek_id, "dataflow": dataflow,
+        "as_of": as_of, "exact": False, "lookup": spec,
+    }
+
+
 def cancel_peek(peek_id: int) -> dict:
     return {"kind": "CancelPeek", "peek_id": peek_id}
 

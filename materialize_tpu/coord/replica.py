@@ -149,6 +149,9 @@ class ReplicaWorker:
                 except OSError:
                     return
                 try:
+                    conn.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
                     conn.settimeout(5.0)
                     msg = ctp.recv_msg(conn)
                     if (
@@ -635,6 +638,7 @@ class ReplicaWorker:
     def _serve_peeks(self, conn) -> bool:
         served = False
         keep = []
+        lookup_buckets: dict = {}
         for p in self.pending_peeks:
             inst = self.dataflows.get(p["dataflow"])
             if inst is None:
@@ -672,6 +676,37 @@ class ReplicaWorker:
                     },
                 )
                 served = True
+                continue
+            if p.get("lookup") is not None:
+                # Batched fast-path gather (coord/peek.py): collect
+                # every READY lookup for the same (dataflow, binding)
+                # this pass — they merge into ONE device gather below
+                # (the replica-side span tick; concurrent controller
+                # batches coalesce further here). No transient
+                # dataflow exists, nothing to render.
+                spec = p["lookup"]
+                from ..utils.dyncfg import (
+                    COMPUTE_CONFIGS,
+                    PEEK_BATCHING,
+                )
+
+                # With peek_batching OFF the plane is per-peek end to
+                # end: every command pays its own gather dispatch (the
+                # serial baseline bench.py --serve measures against).
+                merge_key = (
+                    None
+                    if PEEK_BATCHING(COMPUTE_CONFIGS)
+                    else p["peek_id"]
+                )
+                lookup_buckets.setdefault(
+                    (
+                        p["dataflow"],
+                        tuple(spec.get("bound_cols") or ()),
+                        bool(spec.get("scan")),
+                        merge_key,
+                    ),
+                    [],
+                ).append(p)
                 continue
             exact = bool(p.get("exact")) and as_of is not None
             if exact and as_of != inst.view.upper - 1:
@@ -725,7 +760,93 @@ class ReplicaWorker:
             )
             served = True
         self.pending_peeks = keep
+        for (
+            df_name, bound_cols, scan, _mk
+        ), ps in lookup_buckets.items():
+            served = True
+            self._serve_lookup_bucket(
+                conn, df_name, bound_cols, scan, ps
+            )
         return served
+
+    def _serve_lookup_bucket(
+        self, conn, df_name: str, bound_cols: tuple, scan: bool, ps
+    ) -> None:
+        """Serve every ready lookup peek sharing one (dataflow,
+        binding) signature with ONE stacked gather: the probes of all
+        pending commands concatenate into a single program call, and
+        each command gets its slice of the result groups back."""
+        from .peek import serve_peek_groups
+
+        # Bound the merged gather at a fixed probe tier: an unbounded
+        # merge would hit ever-larger pow2 batch lanes, each paying a
+        # fresh XLA compile mid-serving.
+        MERGE_CAP = 128
+        if len(ps) > 1:
+            total = sum(
+                len(p["lookup"].get("probes") or []) for p in ps
+            )
+            if total > MERGE_CAP:
+                chunk: list = []
+                n = 0
+                for p in ps:
+                    k = len(p["lookup"].get("probes") or [])
+                    if chunk and n + k > MERGE_CAP:
+                        self._serve_lookup_bucket(
+                            conn, df_name, bound_cols, scan, chunk
+                        )
+                        chunk, n = [], 0
+                    chunk.append(p)
+                    n += k
+                if chunk:
+                    self._serve_lookup_bucket(
+                        conn, df_name, bound_cols, scan, chunk
+                    )
+                return
+        inst = self.dataflows.get(df_name)
+        all_probes: list = []
+        slices: list = []
+        for p in ps:
+            probes = p["lookup"].get("probes") or []
+            slices.append((len(all_probes), len(probes)))
+            all_probes.extend(probes)
+        try:
+            if inst is None:
+                raise RuntimeError(f"no such dataflow {df_name}")
+            groups = serve_peek_groups(
+                inst.view,
+                {
+                    "scan": scan,
+                    "bound_cols": bound_cols,
+                    "probes": all_probes,
+                },
+            )
+            served_at = inst.view.upper - 1
+        except Exception as e:
+            for p in ps:
+                ctp.send_msg(
+                    conn,
+                    {
+                        "kind": "PeekResponse",
+                        "peek_id": p["peek_id"],
+                        "error": f"peek lookup failed: {e!r}",
+                        "replica_id": self.replica_id,
+                    },
+                )
+            return
+        for p, (lo, n) in zip(ps, slices):
+            ctp.send_msg(
+                conn,
+                {
+                    "kind": "PeekResponse",
+                    "peek_id": p["peek_id"],
+                    "rows_groups": (
+                        groups if scan else groups[lo : lo + n]
+                    ),
+                    "served_at": served_at,
+                    "replica_id": self.replica_id,
+                },
+            )
 
     def _report_frontiers(self, conn) -> bool:
         changed = {}

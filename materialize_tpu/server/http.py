@@ -135,18 +135,21 @@ def make_handler(coordinator):
                 ).encode()
                 self._reply(200, body, "application/json")
             except Exception as e:
+                from ..coord.peek import ServerBusy
                 from ..sql.hir import PlanError
                 from ..sql.parser import ParseError
 
-                # Client mistakes are 400; execution faults (peek
+                # Client mistakes are 400; admission-control sheds are
+                # 503 (retryable overload); execution faults (peek
                 # timeouts, internal errors) are the server's 500.
-                code = (
-                    400
-                    if isinstance(
-                        e, (PlanError, ParseError, json.JSONDecodeError)
-                    )
-                    else 500
-                )
+                if isinstance(e, ServerBusy):
+                    code = 503
+                elif isinstance(
+                    e, (PlanError, ParseError, json.JSONDecodeError)
+                ):
+                    code = 400
+                else:
+                    code = 500
                 body = json.dumps({"error": str(e)}).encode()
                 self._reply(code, body, "application/json")
 

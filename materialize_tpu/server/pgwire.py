@@ -41,6 +41,16 @@ def _msg(tag: bytes, payload: bytes) -> bytes:
     return tag + struct.pack("!I", len(payload) + 4) + payload
 
 
+def _error_code(e: Exception) -> str:
+    """SQLSTATE for an execution error. Admission-control sheds
+    (coord/peek.ServerBusy) map to 53400 (configuration_limit_exceeded
+    family: insufficient resources, retryable) so clients can
+    distinguish overload from query errors."""
+    from ..coord.peek import ServerBusy
+
+    return "53400" if isinstance(e, ServerBusy) else "XX000"
+
+
 def _cstr(s: str) -> bytes:
     return s.encode() + b"\x00"
 
@@ -256,7 +266,7 @@ class PgConnection:
                 try:
                     res = self.coord.execute(stmt)
                 except Exception as e:  # planning/execution error
-                    self._error("XX000", str(e))
+                    self._error(_error_code(e), str(e))
                     self._ready()
                     return
                 try:
@@ -440,7 +450,7 @@ class PgConnection:
             else:
                 self._send_result(po.sql, res)
         except Exception as e:
-            self._ext_error("XX000", str(e))
+            self._ext_error(_error_code(e), str(e))
 
     def _handle_close(self, payload: bytes) -> None:
         kind = payload[0:1]

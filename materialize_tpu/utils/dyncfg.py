@@ -144,3 +144,68 @@ COMPUTE_RETAIN_HISTORY = Config(
     "for AS OF reads, in virtual timestamps (the read-policy lag "
     "analog, adapter/src/coord/read_policy.rs)",
 ).register(COMPUTE_CONFIGS)
+
+# -- the O(result) serving plane (ISSUE 6 / ROADMAP item 3) -------------------
+
+PEEK_FAST_PATH = Config(
+    "peek_fast_path", True,
+    "serve key-equality lookups and full scans over peekable "
+    "(indexed/materialized) relations by row-gathering directly from "
+    "the maintained spine (coord/peek.py) instead of rendering a "
+    "transient dataflow — O(result) reads, zero installs (the "
+    "adapter-layer peek fast path, coord/peek.rs analog)",
+).register(COMPUTE_CONFIGS)
+
+PEEK_BATCHING = Config(
+    "peek_batching", True,
+    "fan concurrent sessions' fast-path lookups against the same "
+    "index into ONE stacked device gather per batch window, so the "
+    "dispatch round trip (~96ms through the TPU tunnel) is amortized "
+    "across all waiting readers; off = one dispatch per peek",
+).register(COMPUTE_CONFIGS)
+
+PEEK_BATCH_WINDOW_MS = Config(
+    "peek_batch_window_ms", 2.0,
+    "batching span tick: how long queued fast-path lookups wait to be "
+    "stacked into one device gather (latency floor of a batched read)",
+).register(COMPUTE_CONFIGS)
+
+PEEK_MAX_BATCH = Config(
+    "peek_max_batch", 64,
+    "max probes stacked into one gather dispatch (padded to a pow2 "
+    "batch lane so the program compiles once per tier)",
+).register(COMPUTE_CONFIGS)
+
+PEEK_QUEUE_DEPTH = Config(
+    "peek_queue_depth", 1024,
+    "admission control: max fast-path lookups queued for batching; "
+    "arrivals beyond this are shed with a clean 'server busy' error "
+    "(SQLSTATE 53400 at pgwire, HTTP 503) instead of building an "
+    "unbounded backlog",
+).register(COMPUTE_CONFIGS)
+
+PEEK_MAX_INFLIGHT = Config(
+    "peek_max_inflight", 4,
+    "admission control: max batched gather dispatches in flight; the "
+    "flusher holds further batches (queue-depth shedding then "
+    "backpressures arrivals)",
+).register(COMPUTE_CONFIGS)
+
+PEEK_TS_CACHE_MS = Config(
+    "peek_ts_cache_ms", 0.0,
+    "serving-mode timestamp selection: cache a peekable dataflow's "
+    "selected read timestamp for this many milliseconds (invalidated "
+    "by writes through this coordinator). 0 = strict (one consensus "
+    "read per peek); >0 trades bounded staleness w.r.t. out-of-band "
+    "source ticks for not paying a consensus read per peek under "
+    "concurrency (reads within one serving tick share a timestamp)",
+).register(COMPUTE_CONFIGS)
+
+TRANSIENT_PEEK_CACHE = Config(
+    "transient_peek_cache", 8,
+    "memoize slow-path SELECT dataflows by description fingerprint: "
+    "a repeated identical SELECT reuses the installed transient "
+    "dataflow (skipping re-render/re-compile) instead of installing a "
+    "uniquely-named copy; LRU-capped at this many installs, 0 "
+    "disables (PR 1's fingerprint stability exists for exactly this)",
+).register(COMPUTE_CONFIGS)

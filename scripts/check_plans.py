@@ -220,22 +220,8 @@ def run_bench_mode(verbose: bool) -> int:
     rc = 0
     from materialize_tpu.analysis.jaxpr_lint import _carry_finding
 
-    for name, mk in bench_dataflows().items():
-        df = mk()
-        # One abstract trace feeds both the linter and the census
-        # (tracing a TPCH step program costs seconds per config). A
-        # trace-time carry mismatch must still surface as the curated
-        # CARRY_VARY finding, not a crash that skips later configs.
-        try:
-            closed = trace_dataflow_step(df)
-        except TypeError as e:
-            findings = _carry_finding(e)
-            if findings is None:
-                raise
-            closed, n_ops = None, None
-        else:
-            findings = lint_jaxpr(closed)
-            n_ops = kernel_count(closed)
+    def gate(name: str, closed, findings, n_ops) -> None:
+        nonlocal rc
         budget = budgets.get(name)
         over = (
             budget is not None
@@ -257,17 +243,49 @@ def run_bench_mode(verbose: bool) -> int:
                 print(f"  {f}")
             if over:
                 print(
-                    f"  [kernel-budget] step program has {n_ops} ops, "
-                    f"budget is {budget} (tests/kernel_budget.json): "
-                    "a change re-grew the per-step launch count. "
-                    "Either fuse the regression away or consciously "
-                    "raise the budget in the same PR."
+                    f"  [kernel-budget] {name} program has {n_ops} "
+                    f"ops, budget is {budget} "
+                    "(tests/kernel_budget.json): a change re-grew the "
+                    "launch count. Either fuse the regression away or "
+                    "consciously raise the budget in the same PR."
                 )
         else:
             print(
                 f"{name}: clean, {n_ops} ops"
                 + (f" (budget {budget})" if budget is not None else "")
             )
+
+    for name, mk in bench_dataflows().items():
+        df = mk()
+        # One abstract trace feeds both the linter and the census
+        # (tracing a TPCH step program costs seconds per config). A
+        # trace-time carry mismatch must still surface as the curated
+        # CARRY_VARY finding, not a crash that skips later configs.
+        try:
+            closed = trace_dataflow_step(df)
+        except TypeError as e:
+            findings = _carry_finding(e)
+            if findings is None:
+                raise
+            closed, n_ops = None, None
+        else:
+            findings = lint_jaxpr(closed)
+            n_ops = kernel_count(closed)
+        gate(name, closed, findings, n_ops)
+        if name == "index":
+            # The serving plane (round 7, ISSUE 6): the batched-gather
+            # peek programs are budgeted exactly like the step program
+            # — a launch-count regression in the read path fails CI
+            # statically too.
+            from materialize_tpu.coord.peek import trace_peek_programs
+
+            for pname, pclosed in trace_peek_programs(df).items():
+                gate(
+                    pname,
+                    pclosed,
+                    lint_jaxpr(pclosed),
+                    kernel_count(pclosed),
+                )
     return rc
 
 

@@ -222,3 +222,37 @@ def test_index_budget_is_2x_under_prefusion_main():
     ) as f:
         budgets = json.load(f)
     assert budgets["index"] * 2 <= 1193
+
+
+def test_peek_program_budgets_hold():
+    """The serving-plane gather programs (coord/peek.py: scan, masked
+    lookup, hash-lane point) stay within their checked-in launch-count
+    budgets and lint clean over the index config's spine shape — a
+    launch-count regression in the READ path fails CI statically, like
+    the step program (ISSUE 6 satellite)."""
+    import json
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(__file__))
+    scripts_dir = os.path.join(repo, "scripts")
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    import check_plans
+
+    from materialize_tpu.analysis import kernel_count
+    from materialize_tpu.coord.peek import trace_peek_programs
+
+    with open(os.path.join(repo, "tests", "kernel_budget.json")) as f:
+        budgets = json.load(f)
+    df = check_plans.bench_dataflows()["index"]()
+    progs = trace_peek_programs(df)
+    assert set(progs) == {"peek_scan", "peek_lookup", "peek_point"}
+    for name, closed in progs.items():
+        assert lint_jaxpr(closed) == [], name
+        n = kernel_count(closed)
+        assert n <= budgets[name], (
+            f"{name} gather program grew to {n} ops (budget "
+            f"{budgets[name]}): fuse the regression away or "
+            "consciously raise tests/kernel_budget.json in this PR"
+        )

@@ -1,0 +1,370 @@
+"""O(result) peek serving tests (ISSUE 6): fast-path recognition,
+fast-path vs transient-dataflow equivalence under churn, zero dataflow
+installs, batched concurrent lookups, admission-control shedding (and
+that a shed never poisons the sequencing lock), transient-SELECT
+memoization, and pgwire/HTTP parity."""
+
+import socket
+import threading
+
+import pytest
+
+from materialize_tpu.coord.coordinator import Coordinator
+from materialize_tpu.coord.peek import ServerBusy
+from materialize_tpu.coord.protocol import PersistLocation
+from materialize_tpu.coord.replica import serve_forever
+from materialize_tpu.storage.persist import (
+    FileBlob,
+    PersistClient,
+    SqliteConsensus,
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def coord(tmp_path):
+    loc = PersistLocation(
+        str(tmp_path / "blob"), str(tmp_path / "consensus.db")
+    )
+    port = _free_port()
+    ready = threading.Event()
+    threading.Thread(
+        target=serve_forever, args=(port, loc, "r0", ready), daemon=True
+    ).start()
+    assert ready.wait(10)
+    c = Coordinator(
+        PersistClient(
+            FileBlob(loc.blob_root), SqliteConsensus(loc.consensus_path)
+        ),
+        tick_interval=None,
+    )
+    c.add_replica("r0", ("127.0.0.1", port))
+    yield c
+    c.shutdown()
+
+
+def _count_installs(c):
+    installs = []
+    orig = c.controller.create_dataflow
+
+    def counting(desc):
+        installs.append(desc.name)
+        return orig(desc)
+
+    c.controller.create_dataflow = counting
+    return installs
+
+
+# -- plan recognition (plan/decisions.peek_fast_path) ------------------------
+
+
+def test_peek_plan_recognition():
+    from materialize_tpu.expr import relation as mir
+    from materialize_tpu.expr.scalar import (
+        BinaryFunc,
+        CallBinary,
+        Literal,
+        col,
+        lit,
+    )
+    from materialize_tpu.plan.decisions import peek_fast_path
+    from materialize_tpu.repr.schema import Column, ColumnType, Schema
+
+    def eq(c, v):
+        return CallBinary(BinaryFunc.EQ, col(c), lit(v))
+
+    sch = Schema(
+        (
+            Column("a", ColumnType.INT64),
+            Column("b", ColumnType.INT64),
+        )
+    )
+    g = mir.Get("v", sch)
+    peekable = frozenset({"v"})
+
+    assert peek_fast_path(g, peekable).kind == "scan"
+    assert peek_fast_path(g, frozenset()) is None
+
+    f = mir.Filter(g, (eq(0, 3),))
+    dec = peek_fast_path(f, peekable)
+    assert dec.kind == "lookup"
+    assert [c for c, _ in dec.bound] == [0]
+
+    # projection over a filter: bound column tracked to the base
+    p = mir.Project(f, (1,))
+    dec = peek_fast_path(p, peekable)
+    assert dec.kind == "lookup" and dec.projection == (1,)
+
+    # filter above a project: predicate column maps THROUGH the project
+    fp = mir.Filter(mir.Project(g, (1, 0)), (eq(0, 7),))
+    dec = peek_fast_path(fp, peekable)
+    assert dec.kind == "lookup"
+    assert [c for c, _ in dec.bound] == [1]  # output 0 -> base col 1
+
+    # NULL equality and contradictions are empty, zero dispatches
+    fnull = mir.Filter(
+        g,
+        (
+            CallBinary(
+                BinaryFunc.EQ, col(0), Literal(None, ColumnType.INT64)
+            ),
+        ),
+    )
+    assert peek_fast_path(fnull, peekable).kind == "empty"
+    fcontra = mir.Filter(g, (eq(0, 1), eq(0, 2)))
+    assert peek_fast_path(fcontra, peekable).kind == "empty"
+
+    # non-equality predicates and non-chain shapes fall to slow path
+    flt = mir.Filter(
+        g, (CallBinary(BinaryFunc.LT, col(0), lit(3)),)
+    )
+    assert peek_fast_path(flt, peekable) is None
+    red = g.reduce((0,), ())
+    assert peek_fast_path(red, peekable) is None
+    # cross-family literal (float vs int column): slow path, the raw
+    # compare would truncate
+    fx = mir.Filter(
+        g, (CallBinary(BinaryFunc.EQ, col(0), lit(1.5)),)
+    )
+    assert peek_fast_path(fx, peekable) is None
+
+
+# -- serving equivalence + zero installs -------------------------------------
+
+
+def test_fast_path_equivalence_under_churn(coord):
+    """Property test: random key lookups (partial and full bindings)
+    over an indexed view with duplicates and retractions in the spine
+    return rows IDENTICAL to the transient-dataflow path, with zero
+    dataflow installs on the fast path."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    coord.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    coord.execute("CREATE VIEW tv AS SELECT * FROM t")
+    coord.execute("CREATE INDEX ti ON tv")
+    live: list = []
+    for _ in range(12):
+        if live and rng.random() < 0.35:
+            # retract a random batch of existing rows (duplicates too)
+            take = min(len(live), int(rng.integers(1, 6)))
+            idx = rng.choice(len(live), take, replace=False)
+            doomed = {live[i] for i in idx}
+            for row in doomed:
+                # DELETE removes every duplicate of the row at once.
+                coord.execute(
+                    f"DELETE FROM t WHERE k = {row[0]} AND v = {row[1]}"
+                )
+                while row in live:
+                    live.remove(row)
+        n = int(rng.integers(1, 8))
+        rows = [
+            (int(rng.integers(0, 6)), int(rng.integers(0, 4)))
+            for _ in range(n)
+        ]
+        live.extend(rows)
+        vals = ", ".join(f"({k}, {v})" for k, v in rows)
+        coord.execute(f"INSERT INTO t VALUES {vals}")
+
+    queries = ["SELECT * FROM tv"]
+    for _ in range(10):
+        k = int(rng.integers(0, 7))
+        v = int(rng.integers(0, 5))
+        queries.append(f"SELECT * FROM tv WHERE k = {k}")
+        queries.append(f"SELECT v FROM tv WHERE k = {k}")
+        queries.append(
+            f"SELECT * FROM tv WHERE k = {k} AND v = {v}"
+        )
+
+    installs = _count_installs(coord)
+    fast = [coord.execute(q).rows for q in queries]
+    assert installs == [], (
+        f"fast-path SELECTs installed dataflows: {installs}"
+    )
+    coord.update_config({"peek_fast_path": False})
+    try:
+        slow = [coord.execute(q).rows for q in queries]
+    finally:
+        coord.update_config({"peek_fast_path": True})
+    for q, f_rows, s_rows in zip(queries, fast, slow):
+        assert sorted(f_rows) == sorted(s_rows), (
+            q, f_rows, s_rows
+        )
+
+
+def test_fast_path_respects_order_limit(coord):
+    coord.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    coord.execute(
+        "INSERT INTO t VALUES (1, 30), (1, 10), (1, 20), (2, 5)"
+    )
+    coord.execute("CREATE VIEW tv AS SELECT * FROM t")
+    coord.execute("CREATE INDEX ti ON tv")
+    installs = _count_installs(coord)
+    # ORDER BY is host-side finishing: still fast path
+    r = coord.execute(
+        "SELECT v FROM tv WHERE k = 1 ORDER BY v DESC"
+    )
+    assert r.rows == [(30,), (20,), (10,)]
+    assert installs == []
+    # LIMIT plans as a TopK operator — legitimately the slow path,
+    # same rows
+    r = coord.execute(
+        "SELECT v FROM tv WHERE k = 1 ORDER BY v DESC LIMIT 2"
+    )
+    assert r.rows == [(30,), (20,)]
+
+
+def test_explain_analysis_shows_peek_decision(coord):
+    coord.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    coord.execute("CREATE VIEW tv AS SELECT * FROM t")
+    coord.execute("CREATE INDEX ti ON tv")
+    txt = coord.execute(
+        "EXPLAIN ANALYSIS SELECT * FROM tv WHERE k = 2"
+    ).text
+    assert "peek: fast path: index lookup on 'tv'" in txt
+    txt = coord.execute("EXPLAIN ANALYSIS SELECT * FROM tv").text
+    assert "full index scan" in txt
+    txt = coord.execute(
+        "EXPLAIN ANALYSIS SELECT count(*) FROM tv"
+    ).text
+    assert "slow path" in txt
+
+
+# -- batching + admission control --------------------------------------------
+
+
+def test_concurrent_lookups_batch(coord):
+    coord.execute("CREATE TABLE t (k BIGINT, v BIGINT)")
+    rows = ", ".join(f"({i % 20}, {i})" for i in range(200))
+    coord.execute(f"INSERT INTO t VALUES {rows}")
+    coord.execute("CREATE VIEW tv AS SELECT * FROM t")
+    coord.execute("CREATE INDEX ti ON tv")
+    coord.execute("SELECT * FROM tv WHERE k = 0")  # warm the program
+
+    base = coord.controller.peek_stats()
+    results: dict = {}
+
+    def client(tid):
+        results[tid] = coord.fast_peek_values(
+            "tv", (tid % 20,), (0,)
+        )
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(48)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert all(not t.is_alive() for t in threads)
+    for tid, out in results.items():
+        expect = sorted(
+            (tid % 20, i) for i in range(200) if i % 20 == tid % 20
+        )
+        assert sorted(out) == expect
+    stats = coord.controller.peek_stats()
+    n_lookups = stats["lookups"] - base["lookups"]
+    n_batches = stats["batches"] - base["batches"]
+    assert n_lookups == 48
+    assert n_batches < n_lookups, (
+        "concurrent lookups never shared a batch"
+    )
+
+
+def test_shed_releases_lock_and_does_not_poison(coord):
+    """Queue-depth shedding raises a clean ServerBusy AND releases the
+    sequencing lock: subsequent DDL (from another thread) and SELECTs
+    must proceed normally (ISSUE 6 satellite)."""
+    coord.execute("CREATE TABLE t (k BIGINT)")
+    coord.execute("INSERT INTO t VALUES (1), (2)")
+    coord.execute("CREATE VIEW tv AS SELECT * FROM t")
+    coord.execute("CREATE INDEX ti ON tv")
+    coord.execute("SELECT * FROM tv WHERE k = 1")
+    coord.update_config({"peek_queue_depth": 0})
+    try:
+        with pytest.raises(ServerBusy):
+            coord.execute("SELECT * FROM tv WHERE k = 1")
+        # DDL from ANOTHER thread: deadlocks if the shed leaked the
+        # sequencing lock.
+        done = {}
+
+        def ddl():
+            coord.execute("CREATE VIEW tv2 AS SELECT k FROM t")
+            done["ok"] = True
+
+        th = threading.Thread(target=ddl, daemon=True)
+        th.start()
+        th.join(20)
+        assert done.get("ok"), "DDL deadlocked after a shed peek"
+    finally:
+        coord.update_config({"peek_queue_depth": None})
+    assert coord.execute("SELECT * FROM tv WHERE k = 2").rows == [(2,)]
+    stats = coord.controller.peek_stats()
+    assert stats["shed"] >= 1
+
+
+# -- transient-SELECT memoization --------------------------------------------
+
+
+def test_transient_peek_memoized(coord):
+    coord.execute("CREATE TABLE t (k BIGINT)")
+    coord.execute("INSERT INTO t VALUES (1), (2), (3)")
+    installs = _count_installs(coord)
+    q = "SELECT count(*) FROM t WHERE k > 1"
+    assert coord.execute(q).rows == [(2,)]
+    assert coord.execute(q).rows == [(2,)]
+    assert len(installs) == 1, (
+        f"identical SELECT re-installed: {installs}"
+    )
+    # the memoized dataflow keeps maintaining: a later write is visible
+    coord.execute("INSERT INTO t VALUES (4)")
+    assert coord.execute(q).rows == [(3,)]
+    assert len(installs) == 1
+    # a different query is its own install
+    assert coord.execute(
+        "SELECT count(*) FROM t WHERE k > 2"
+    ).rows == [(2,)]
+    assert len(installs) == 2
+
+
+def test_transient_cache_evicts_lru(coord):
+    coord.execute("CREATE TABLE t (k BIGINT)")
+    coord.execute("INSERT INTO t VALUES (1)")
+    coord.update_config({"transient_peek_cache": 2})
+    try:
+        installs = _count_installs(coord)
+        for i in range(4):
+            coord.execute(f"SELECT count(*) FROM t WHERE k > {i}")
+        assert len(installs) == 4
+        assert len(coord._transient_cache) == 2
+        # the two newest are cached; re-running them installs nothing
+        coord.execute("SELECT count(*) FROM t WHERE k > 3")
+        coord.execute("SELECT count(*) FROM t WHERE k > 2")
+        assert len(installs) == 4
+        # an evicted one reinstalls
+        coord.execute("SELECT count(*) FROM t WHERE k > 0")
+        assert len(installs) == 5
+    finally:
+        coord.update_config({"transient_peek_cache": None})
+
+
+def test_drop_index_with_cached_transient_importing_it(coord):
+    """A memoized transient dataflow that index-imports the dropped
+    index must not block the DROP (the cache flushes first)."""
+    coord.execute("CREATE TABLE t (k BIGINT)")
+    coord.execute("INSERT INTO t VALUES (1), (2)")
+    coord.execute("CREATE VIEW tv AS SELECT * FROM t")
+    coord.execute("CREATE INDEX ti ON tv")
+    # a NON-fast-path SELECT over the indexed view: the transient
+    # dataflow imports ti's arrangement and stays cached
+    assert coord.execute("SELECT count(*) FROM tv").rows == [(2,)]
+    assert coord._transient_cache
+    coord.execute("DROP INDEX ti")  # must not raise
+    assert not coord._transient_cache
