@@ -19,6 +19,10 @@ Three passes:
   append-only" — consumed by threshold elision and reduce/topk planning.
 - ``jaxpr_lint``: walks a rendered step function's jaxpr for TPU
   hazards; surfaced via scripts/check_plans.py and the test suite.
+- ``host_sync``: AST lint of the per-span HOT PATH's Python source for
+  accidental host sync points (np.asarray / .item() /
+  block_until_ready / un-donated device_put) — the pipelined control
+  plane's one-readback-per-span invariant, enforced statically.
 
 See doc/analysis.md for the catalogue of invariants and lints.
 """
@@ -32,6 +36,12 @@ from .jaxpr_lint import (  # noqa: F401
     lint_step_fn,
     op_census,
     trace_dataflow_step,
+)
+from .host_sync import (  # noqa: F401
+    HOST_SYNC,
+    host_sync_findings_dataflow,
+    lint_function,
+    lint_hot_path,
 )
 from .monotonic import (  # noqa: F401
     BOTTOM,

@@ -653,6 +653,28 @@ def compact_level(spine: Spine, level: int) -> tuple[Spine, jnp.ndarray]:
     return out, overflow
 
 
+_CLONE_JITS: dict = {}
+
+
+def clone_state_tree(tree):
+    """Deep-copy every device leaf of a state pytree (arrangements,
+    spines, batches, scalars) to FRESH buffers in ONE fused program.
+
+    Donation safety (the pipelined span executor's checkpoint
+    contract): a span program compiled with ``donate_argnums`` hands
+    its carry buffers to XLA — after dispatch they are dead and must
+    never be read again. The rollback checkpoint therefore cannot hold
+    references into the carry; it holds this clone instead. jit
+    outputs never alias un-donated inputs, so every returned leaf is a
+    fresh buffer."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    jitfn = _CLONE_JITS.get(len(leaves))
+    if jitfn is None:
+        jitfn = jax.jit(lambda *ls: tuple(jnp.copy(l) for l in ls))
+        _CLONE_JITS[len(leaves)] = jitfn
+    return jax.tree_util.tree_unflatten(treedef, jitfn(*leaves))
+
+
 def compact_spine(spine: Spine):
     """Full cascade: fold every slot and run into the base (peeks and
     snapshots read the base as THE consolidated state). Cascades

@@ -446,6 +446,12 @@ class ComputeController:
         # thread, read by caller threads).
         self.frontiers: dict[str, dict[str, int]] = {}  # df -> replica -> upper
         self.arrangement_records: dict[str, dict[str, int]] = {}
+        # Monotone COMMITTED span counters (ISSUE 7, df -> replica ->
+        # epoch): the span boundary each reported frontier belongs to.
+        # Peeks and compaction decisions sequence against boundaries,
+        # not individual ticks — the counter is the observable identity
+        # of a boundary.
+        self.span_epochs: dict[str, dict[str, int]] = {}
         self.statuses: deque = deque(maxlen=1000)  # replica error reports
         # Install acks: df name -> replica -> error string | None (ok).
         self.install_acks: dict[str, dict] = {}
@@ -501,6 +507,8 @@ class ComputeController:
             for per_df in self.frontiers.values():
                 per_df.pop(name, None)
             for per_df in self.arrangement_records.values():
+                per_df.pop(name, None)
+            for per_df in self.span_epochs.values():
                 per_df.pop(name, None)
 
     def _history_snapshot(self):
@@ -558,6 +566,7 @@ class ComputeController:
             self._dataflows.pop(name, None)
             self.frontiers.pop(name, None)
             self.arrangement_records.pop(name, None)
+            self.span_epochs.pop(name, None)
             self.install_acks.pop(name, None)
         self._broadcast(ctp.drop_dataflow(name))
 
@@ -643,6 +652,12 @@ class ComputeController:
                             self.arrangement_records.setdefault(df, {})[
                                 replica
                             ] = n
+                        for df, e in msg.get(
+                            "span_epochs", {}
+                        ).items():
+                            self.span_epochs.setdefault(df, {})[
+                                replica
+                            ] = e
             elif kind == "Status":
                 with self._lock:
                     self.statuses.append(msg)
@@ -669,6 +684,15 @@ class ComputeController:
                 return 0
             per = self.frontiers.get(dataflow, {})
             return min(per.get(name, 0) for name in self.replicas)
+
+    def span_epoch(self, dataflow: str) -> int:
+        """The serving span boundary: MAX committed span epoch over
+        replicas (some replica serves at this boundary). Monotone —
+        two reads straddling an increment are separated by at least
+        one committed span."""
+        with self._lock:
+            per = self.span_epochs.get(dataflow)
+            return max(per.values()) if per else 0
 
     def any_frontier(self, dataflow: str) -> int:
         """The serving frontier: MAX over replicas (some replica can

@@ -1460,6 +1460,18 @@ class Coordinator:
                 and _time.monotonic() - hit[1] < ttl
             ):
                 return hit[0]
+        # Pipelined replicas (ISSUE 7): the selected source time may
+        # run up to one span ahead of the replica's COMMITTED
+        # frontier. The read does NOT clamp to the reported frontier —
+        # that would break read-your-writes (the write epoch
+        # invalidates this cache precisely so a post-write read
+        # re-selects a timestamp covering the write, and the reported
+        # frontier can lag it). Instead the replica sequences the
+        # admitted peek itself: a pending peek whose as_of is past the
+        # committed frontier forces the in-flight span's boundary
+        # readback (replica._serve_peeks -> view.sync_spans), so the
+        # wait is one boundary commit, not a stall behind the span
+        # pipeline.
         as_of = self._select_timestamp_shards(
             self._df_upstream.get(df, [])
         )

@@ -41,6 +41,13 @@ INTROSPECTION_SCHEMAS: dict[str, Schema] = {
     "mz_arrangement_sizes": Schema(
         [Column("dataflow", S), Column("replica", S), Column("records", I)]
     ),
+    "mz_span_epochs": Schema(
+        [
+            Column("dataflow", S),
+            Column("replica", S),
+            Column("span_epoch", I),
+        ]
+    ),
     "mz_metrics": Schema(
         [Column("metric", S), Column("value", F)]
     ),
@@ -111,6 +118,21 @@ def snapshot(coord, name: str) -> list[tuple]:
             (_enc(df), _enc(rep), n)
             for df, per in sorted(snap.items())
             for rep, n in sorted(per.items())
+        ]
+    if name == "mz_span_epochs":
+        # The pipelined control plane's committed span boundaries
+        # (ISSUE 7): per (dataflow, replica), the monotone span-epoch
+        # counter frontier reports ride on — the observable identity
+        # peeks and compaction sequence against.
+        with coord.controller._lock:
+            snap = {
+                df: dict(per)
+                for df, per in coord.controller.span_epochs.items()
+            }
+        return [
+            (_enc(df), _enc(rep), e)
+            for df, per in sorted(snap.items())
+            for rep, e in sorted(per.items())
         ]
     if name == "mz_metrics":
         from ..utils.metrics import REGISTRY

@@ -184,3 +184,21 @@ def allow_compaction(dataflow: str, since: int) -> dict:
 
 def update_configuration(params: dict) -> dict:
     return {"kind": "UpdateConfiguration", "params": params}
+
+
+def frontiers(
+    uppers: dict, records: dict, span_epochs: dict, replica_id: str
+) -> dict:
+    """Replica -> controller frontier report. ``span_epochs`` carries
+    each dataflow's monotone COMMITTED span counter (ISSUE 7: the
+    pipelined control plane commits frontiers once per span, and
+    peeks/compaction sequence against span boundaries — the counter
+    is the boundary identity a coordinator can reason about without
+    another round trip)."""
+    return {
+        "kind": "Frontiers",
+        "uppers": uppers,
+        "records": records,
+        "span_epochs": span_epochs,
+        "replica_id": replica_id,
+    }

@@ -255,10 +255,22 @@ class ReplicaWorker:
                     worked = True
             except queue.Empty:
                 pass
+            from ..utils.dyncfg import COMPUTE_CONFIGS, SPAN_PIPELINING
+
+            pipelined = SPAN_PIPELINING(COMPUTE_CONFIGS)
             for name, inst in list(self.dataflows.items()):
                 try:
-                    # Non-blocking step: only if some input advanced.
-                    if inst.view.step(timeout=0):
+                    # Non-blocking: only if some input advanced. The
+                    # pipelined span path (ISSUE 7) dispatches every
+                    # READY micro-batch as one deferred span and
+                    # commits span K at its single boundary readback
+                    # while span K+1 executes — device occupancy, not
+                    # per-tick round trips, limits throughput.
+                    if (
+                        inst.view.step_span(timeout=0)
+                        if pipelined
+                        else inst.view.step(timeout=0)
+                    ):
                         worked = True
                 except SinkConflict:
                     # Another replica's durable chunking won a hydration
@@ -655,8 +667,20 @@ class ReplicaWorker:
                 continue
             as_of = p["as_of"]
             if as_of is not None and inst.view.upper <= as_of:
+                # Peek timestamp sequencing under pipelined ticks
+                # (ISSUE 7): the data may already be DISPATCHED in an
+                # in-flight span — commit its boundary before deciding
+                # the peek is not ready, so an admitted peek never
+                # waits a full extra span behind the committed
+                # frontier.
+                inst.view.sync_spans()
+            if as_of is not None and inst.view.upper <= as_of:
                 keep.append(p)  # not yet complete at as_of
                 continue
+            # Every serving path below reads maintained state; it must
+            # observe a COMMITTED span boundary, never the in-flight
+            # span's half-applied carry.
+            inst.view.sync_spans()
             # ok/err pair: a nonempty err collection poisons reads until
             # the offending rows are retracted (render.rs:12-101 — "SQL
             # picks an arbitrary error if errs nonempty").
@@ -813,6 +837,9 @@ class ReplicaWorker:
         try:
             if inst is None:
                 raise RuntimeError(f"no such dataflow {df_name}")
+            # Gathers read the maintained spine directly: sequence to
+            # a committed span boundary first (no half-applied carry).
+            inst.view.sync_spans()
             groups = serve_peek_groups(
                 inst.view,
                 {
@@ -851,26 +878,30 @@ class ReplicaWorker:
     def _report_frontiers(self, conn) -> bool:
         changed = {}
         records = {}
+        epochs = {}
         for name, inst in self.dataflows.items():
             upper = inst.view.upper
             if upper != inst.reported_upper:
                 changed[name] = upper
                 inst.reported_upper = upper
+                # Monotone span-epoch counter (ISSUE 7): the committed
+                # span boundary this frontier belongs to — peeks and
+                # compaction decisions sequence against it.
+                epochs[name] = inst.view.span_epoch
                 # Arrangement introspection (mz_arrangement_sizes
                 # analog): the output arrangement's current row count.
-                # One small device->host read, only on frontier change.
+                # One small device->host read, only on frontier change
+                # (may slightly overcount rows an in-flight span is
+                # still inserting — introspection only).
                 import numpy as _np
 
                 records[name] = inst.view.df.output_records()
         if changed:
             ctp.send_msg(
                 conn,
-                {
-                    "kind": "Frontiers",
-                    "uppers": changed,
-                    "records": records,
-                    "replica_id": self.replica_id,
-                },
+                ctp.frontiers(
+                    changed, records, epochs, self.replica_id
+                ),
             )
             return True
         return False

@@ -61,15 +61,17 @@ def ingest_mode(
 
 def state_ingest_mode(state_capacity: int, tail_capacity: int = 1024) -> str:
     """Ingest decision for OPERATOR-STATE spines (join/delta-join
-    arrangements). The dyncfg override is respected, but `auto`
-    resolves to 'merge' here for now: a slot ring per arrangement part
-    multiplies per-operator memory, and regrowing the ring through a
-    delta-join step program makes the CPU tier probe (bench.py
-    --reprobe) blow the driver's time budget — the exact failure mode
-    ISSUE 5's bench satellite removes. Flip the default to the
-    big-state rule (ingest_mode) once bench_tiers.json is regenerated
-    on a host that can afford the probe. The render layer and the
-    slotted-join tests exercise the append_slot path via the dyncfg."""
+    arrangements). `auto` now resolves by the SAME big-state rule as
+    the output index (ingest_mode): append-slot once the state tier is
+    >= 8x the ingest tier. The round-6 deferral — auto forced 'merge'
+    because regrowing a per-arrangement slot ring through a delta-join
+    step program blew the CPU tier probe's budget — is paid off:
+    bench_tiers.json was regenerated on this host with slotted
+    operator-state spines (ISSUE 7 satellite; doc/perf.md), so the
+    measuring process compiles only final-tier programs and the probe
+    cost is a one-time CPU pass. SPMD still forces 'merge' at the
+    render layer (the slot cursor is a replicated scalar the shard_map
+    boundary specs do not carry)."""
     from ..utils.dyncfg import (
         ARRANGEMENT_INGEST_MODE,
         COMPUTE_CONFIGS,
@@ -78,7 +80,11 @@ def state_ingest_mode(state_capacity: int, tail_capacity: int = 1024) -> str:
     mode = ARRANGEMENT_INGEST_MODE(COMPUTE_CONFIGS)
     if mode != "auto":
         return mode
-    return "merge"
+    return (
+        "append_slot"
+        if state_capacity >= 8 * tail_capacity
+        else "merge"
+    )
 
 
 def plan_reduce(aggregates) -> ReducePlan:

@@ -78,6 +78,19 @@ def _span_compiler_options():
     return None
 
 
+def _donation_supported() -> bool:
+    """Whether the backend honors ``donate_argnums``. CPU ignores
+    donation (warning per buffer) — and jaxlib 0.4.37 has been
+    observed to SEGFAULT lowering large donated span programs under
+    the forced multi-device host platform the test suite uses — so
+    the argnums are wired only where they do something. The
+    donation-SAFETY contract (cloned rollback checkpoint, span-
+    boundary read barrier) stays backend-independent: callers request
+    donation, the clone always happens, the argnums follow the
+    backend."""
+    return jax.default_backend() in ("tpu", "axon")
+
+
 @dataclass
 class _StateSlot:
     index: int
@@ -1013,6 +1026,13 @@ class _DataflowBase:
         self._compact_tick = 0
         self._compact_jits: dict = {}
         self._covf_keys = self._compact_keys()
+        # Pipelined-control-plane bookkeeping (ISSUE 7): d2h readback
+        # census (every flags transfer increments it — the trace's
+        # readbacks-per-span counter reads deltas of this), and the
+        # span executor attached to this dataflow, if any (reads of
+        # dataflow state sequence against its span boundaries).
+        self._readbacks = 0
+        self._span_exec = None
 
     # Back-compat shim for callers that poked the old counter directly.
     @property
@@ -1569,6 +1589,7 @@ class _DataflowBase:
         PERF_NOTES.md). Latency-critical paths defer this via
         run_steps(defer_check=True) + check_flags()."""
         if flags_or is not None and keys:
+            self._readbacks += 1
             fh = np.asarray(flags_or)  # [nkeys] or [nkeys, P]
             return fh.reshape(len(keys), -1).any(axis=1)
         return np.zeros(len(keys) if keys else 0, dtype=bool)
@@ -1605,6 +1626,7 @@ class _DataflowBase:
         (device-resident). Forces a spine compaction first — peeks are
         off the hot path (compute_state.rs:744 handle_peek reads a
         trace cursor; here the compacted base run IS the cursor)."""
+        self.span_barrier()
         self.check_flags()
         self._compact_now()
         return self.output.base
@@ -1613,7 +1635,12 @@ class _DataflowBase:
         """Approximate maintained row count (sum over all runs and
         ingest slots; may overcount rows whose diffs cancel across
         runs until the next compaction). Introspection only — one
-        small d2h read."""
+        small d2h read. Deliberately NOT span-barriered: the replica
+        reports records alongside every frontier change, and syncing
+        there would serialize the span double-buffer once per loop;
+        counts may include rows an in-flight span is still inserting
+        (the refs are that span's OUTPUT buffers — always valid, even
+        under donation)."""
         return int(
             sum(
                 np.asarray(b.count).sum()
@@ -1642,6 +1669,7 @@ class _DataflowBase:
         :meth:`check_flags` returns False; when it returns True, the
         corrected per-step deltas of the replay are available on
         ``self.replayed_deltas`` (in dispatch order)."""
+        self.span_barrier()
         if getattr(self, "_first_time", None) is None:
             # The dataflow's as_of: the first processed timestamp
             # (constants fire exactly here; baked at trace time).
@@ -1714,12 +1742,21 @@ class _DataflowBase:
                     deepest = max(deepest, compact_depth(s) - 1)
         return deepest
 
-    def _make_span_jit(self, with_env: bool):
+    def _make_span_jit(self, with_env: bool, donate: bool = False):
         """ONE program for every span shape: an outer lax.scan over
         chunks whose xs carry (chunk inputs, compaction level) — the
         geometric cadence is RUNTIME DATA dispatched with lax.switch,
         so the pattern never forces a recompile (the unrolled-chunk
-        form compiled one ~3-minute variant per distinct pattern)."""
+        form compiled one ~3-minute variant per distinct pattern).
+
+        ``donate`` donates the carry argnums (states, output spine,
+        err arrangement, device time) so XLA writes each span's output
+        state into the input state's buffers instead of allocating and
+        copying state-sized arrays per dispatch (the h2d/HBM traffic
+        saver of the pipelined control plane). Donated inputs are DEAD
+        after the call — see _clone_checkpoint for the rollback
+        contract; backends without donation support (CPU) silently
+        ignore it."""
         ce = self._compact_every
         n_branches = self._max_compact_level() + 1
 
@@ -1830,15 +1867,22 @@ class _DataflowBase:
             )
             return carry, deltas_all, sfls.any(axis=0), cfls.any(axis=0)
 
-        return jax.jit(span, compiler_options=_span_compiler_options())
+        return jax.jit(
+            span,
+            compiler_options=_span_compiler_options(),
+            donate_argnums=(0, 1, 2, 3) if donate else (),
+        )
 
-    def run_span(self, inputs_list: list):
+    def run_span(self, inputs_list: list, donate: bool = False):
         """Feed a span of micro-batches as ONE device dispatch (deferred
         overflow checks — see run_steps). The span length must be a
         multiple of ``_compact_every``; spine compaction runs on device
         between scan chunks. Returns the stacked per-step output deltas
         (leaves shaped [K, ...], device-resident, PROVISIONAL until
-        check_flags)."""
+        check_flags). ``donate`` hands the carry's buffers to the span
+        program (see _make_span_jit); the defer checkpoint is then a
+        fresh-buffer clone."""
+        self.span_barrier()
         ce = self._compact_every
         if len(inputs_list) % ce != 0:
             raise ValueError(
@@ -1851,9 +1895,13 @@ class _DataflowBase:
         self._check_slot_ring()
         # Checkpoint BEFORE any dispatch (including the flush
         # compaction below): an overflow discovered at check_flags
-        # time must be able to roll all of it back.
+        # time must be able to roll all of it back. Donated spans
+        # clone the checkpoint to fresh buffers — the live carry's
+        # buffers die at dispatch.
         if self._defer_ck is None:
-            self._defer_ck = self._checkpoint()
+            self._defer_ck = (
+                self._clone_checkpoint() if donate else self._checkpoint()
+            )
         if self._compact_tick % ce:
             # Flush (full cascade) so the span's internal compaction
             # schedule starts from a clean counter.
@@ -1877,10 +1925,11 @@ class _DataflowBase:
         )
         if not hasattr(self, "_span_jits"):
             self._span_jits = {}
-        key = (ce, n_chunks, env is not None)
+        donate = donate and _donation_supported()
+        key = (ce, n_chunks, env is not None, donate)
         jitfn = self._span_jits.get(key)
         if jitfn is None:
-            jitfn = self._make_span_jit(env is not None)
+            jitfn = self._make_span_jit(env is not None, donate=donate)
             self._span_jits[key] = jitfn
         stacked = self._stack_packed(packed)
         chunks = jax.tree_util.tree_map(
@@ -1891,9 +1940,12 @@ class _DataflowBase:
             self._time_dev, chunks, levels,
         )
         if env is not None:
-            carry, deltas, sfl, cfl = jitfn(*args, env)
-        else:
-            carry, deltas, sfl, cfl = jitfn(*args)
+            args = args + (env,)
+        # No donation-warning suppression needed: `donate` was
+        # narrowed above to backends that honor donate_argnums, so
+        # the CPU "donated buffers were not usable" warning is
+        # unreachable here by construction.
+        carry, deltas, sfl, cfl = jitfn(*args)
         st, o, e, t = carry
         self.states = list(st)
         self.output = o
@@ -1949,6 +2001,71 @@ class _DataflowBase:
                 for k in ovf:
                     self._grow_for(k)
         return True
+
+    # -- pipelined span boundaries (ISSUE 7) --------------------------------
+    #
+    # The double-buffered executor protocol: dispatch span K+1, THEN
+    # read span K's accumulated overflow flags — the readback blocks
+    # exactly until span K's program finished (all of a dispatch's
+    # outputs become ready together), while span K+1 is already queued
+    # behind it on device. One snapshot readback per span is the
+    # span's entire d2h traffic.
+
+    def flags_snapshot(self):
+        """Reference the OR-accumulated deferred overflow flags AS OF
+        NOW. Flags accumulate monotonically (logical_or), so a
+        snapshot taken after dispatching span K covers every span
+        <= K and nothing after — reading it is the span-boundary
+        commit check."""
+        return (self._defer_flags, self._defer_cflags)
+
+    def read_flags_snapshot(self, snap) -> bool:
+        """ONE fused d2h readback of a flags snapshot; True if any
+        overflow occurred up to the snapshot point (the caller then
+        runs :meth:`check_flags` for the rollback+replay). Blocks
+        until the snapshot's producing span has finished executing —
+        this is the pipelined executor's per-span sync point."""
+        f, c = snap
+        parts = []
+        if f is not None:
+            parts.append(jnp.ravel(jnp.asarray(f)).astype(jnp.uint8))
+        if c is not None:
+            parts.append(jnp.ravel(jnp.asarray(c)).astype(jnp.uint8))
+        if not parts:
+            return False
+        fused = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        self._readbacks += 1
+        return bool(
+            np.asarray(fused).any()  # host-sync: ok(the ONE boundary readback per span)
+        )
+
+    def span_barrier(self) -> None:
+        """Sequence a state read against span boundaries: when a
+        pipelined span executor is attached, an in-flight span's carry
+        may hold donated (dead) buffers and a provisional frontier —
+        complete and commit it before reading dataflow state. No-op
+        without an executor or from the executor's own dispatch."""
+        ex = self._span_exec
+        if ex is not None and not ex.in_dispatch:
+            ex.sync()
+
+    def _clone_checkpoint(self):
+        """A rollback checkpoint whose device leaves are FRESH buffer
+        copies — required before the first DONATED span dispatch of a
+        defer window: donation hands the live carry's buffers to XLA,
+        so a plain reference checkpoint would resurrect dead buffers
+        on rollback."""
+        from ..arrangement.spine import clone_state_tree
+
+        st, out, err, tdev = clone_state_tree(
+            (
+                tuple(self.states),
+                self.output,
+                self.err_output,
+                self._time_dev,
+            )
+        )
+        return (list(st), out, err, self.time, tdev, self._compact_tick)
 
 
 class Dataflow(_DataflowBase):
@@ -2095,6 +2212,7 @@ class Dataflow(_DataflowBase):
         trace-time fact) skip the device readback entirely."""
         if not getattr(self, "_has_errors", False):
             return []
+        self.span_barrier()
         self.check_flags()
         return self._accumulate_errors(self.err_output.batch.to_rows())
 
@@ -2331,11 +2449,14 @@ class ShardedDataflow(_DataflowBase):
 
         self._step_jit = jax.jit(step)
 
-    def run_span(self, inputs_list: list):
+    def run_span(self, inputs_list: list, donate: bool = False):
         raise NotImplementedError(
             "span-scan execution is single-device for now; sharded "
-            "dataflows use run_steps (the shard_map step is already "
-            "one dispatch per step)"
+            "dataflows pipeline through run_steps(defer_check=True) + "
+            "flags snapshots instead (the shard_map step is already "
+            "one dispatch per step, and its packed flags ride the "
+            "same deferred logical_or accumulator) — see ROADMAP "
+            "item 2 for the sharded slot-ring/span design"
         )
 
     def _make_compact_jit(self, max_level: int = 10**9):
@@ -2460,6 +2581,7 @@ class ShardedDataflow(_DataflowBase):
         """Gather every worker's err shard: [(err_code, count)]."""
         if not getattr(self, "_has_errors", False):
             return []
+        self.span_barrier()
         self.check_flags()
         return self._accumulate_errors(
             self._gather_batch(self.err_output.batch).to_rows()

@@ -119,6 +119,28 @@ def _host_updates(batch: Batch):
     )[:n]
 
 
+def _hist_host(entry):
+    """A multiversion-history entry as host update arrays. Entries are
+    stored as the step's DEVICE delta batch (the pipelined span path
+    records history with zero readbacks — PERF_NOTES round 8) and
+    converted lazily on the rare rewind read; pre-existing host-tuple
+    entries (SPMD gathers) pass through. Prefer :func:`_hist_host_at`
+    when iterating a history list — it memoizes the conversion."""
+    return entry if isinstance(entry, tuple) else _host_updates(entry)
+
+
+def _hist_host_at(history: list, i: int):
+    """Host view of ``history[i]``'s update, MEMOIZED in place:
+    repeated AS OF rewinds and multiple IndexSource subscribers then
+    pay one d2h conversion per entry total, not one per read (through
+    the TPU tunnel each conversion is a real round trip)."""
+    t, upd = history[i]
+    host = _hist_host(upd)
+    if host is not upd:
+        history[i] = (t, host)
+    return host
+
+
 class IndexSource:
     """Import a live sibling dataflow's output arrangement as an input —
     the TraceManager sharing analog (compute/src/arrangement/manager.rs:33,
@@ -198,6 +220,10 @@ class IndexSource:
 
         self._device = type(publisher.df) is _SingleDevice
         self.host_transfers = 0  # observability for tests
+        # The base snapshot must be a COMMITTED span boundary: a
+        # pipelined publisher may hold an in-flight span whose carry
+        # is not yet validated (ISSUE 7 sequencing rule).
+        publisher.sync_spans()
         if self._device:
             self.base_batch = publisher.df.output_batch()
         else:
@@ -282,9 +308,11 @@ class IndexSource:
             # replay: they are folded into the base (not in _pending),
             # and a subscriber stepping past as_of needs them back.
             replay = []
-            for ht, upd in pub._history:
+            for hi, (ht, _upd) in enumerate(pub._history):
                 if as_of < ht <= self.base_upper - 1:
-                    cols, nulls, htime, diff = upd
+                    cols, nulls, htime, diff = _hist_host_at(
+                        pub._history, hi
+                    )
                     parts.append((cols, nulls, htime, np.negative(diff)))
                     replay.append(
                         (
@@ -294,7 +322,7 @@ class IndexSource:
                                 diff, ht,
                             )
                             if self._device
-                            else upd,
+                            else (cols, nulls, htime, diff),
                         )
                     )
             self._pending = replay + self._pending
@@ -354,6 +382,35 @@ class IndexSource:
         return updates_to_batch(
             self.schema, cols, nulls, time, diff, target - 1
         )
+
+
+class _ViewSpanBarrier:
+    """Adapter registering a MaintainedView's span pipeline as its
+    dataflow's ``_span_exec`` barrier: df-level state reads
+    (``output_batch``/``output_records``/``run_steps``/
+    ``peek_errors`` call ``span_barrier()``) then commit the view's
+    in-flight span first — the same contract render/span_exec's
+    executor provides — instead of relying only on the view-level
+    ``sync_spans()`` call sites. ``in_dispatch`` is raised around the
+    view's own span dispatch so dispatching never self-syncs (which
+    would serialize the double buffer).
+
+    The view's pipeline intentionally re-implements the boundary
+    protocol rather than wrapping a SpanExecutor: the executor drives
+    ``run_span`` (stacked multiple-of-compact-every spans, one fused
+    program), while the view needs per-tick deltas and frontier
+    bookkeeping from ``run_steps`` trains — the shared pieces
+    (flags snapshots, one-readback commit, window rollback) live in
+    ``_DataflowBase``."""
+
+    __slots__ = ("view", "in_dispatch")
+
+    def __init__(self, view: "MaintainedView"):
+        self.view = view
+        self.in_dispatch = False
+
+    def sync(self) -> None:
+        self.view.sync_spans()
 
 
 class MaintainedView:
@@ -422,11 +479,26 @@ class MaintainedView:
         # in the sink. Appends behind the durable upper skip benignly
         # (identical content by determinism + 1-timestamp chunks).
         self._upper = 0
+        # Pipelined span state (ISSUE 7): the DISPATCHED frontier runs
+        # ahead of the committed one by at most one span;
+        # `_inflight_span` holds (flags snapshot, [(t, delta)], target)
+        # until its boundary readback commits it. `span_epoch` is the
+        # monotone span counter peeks and compaction decisions
+        # sequence against (reported with every Frontiers message).
+        self._dispatched = 0
+        self._inflight_span = None
+        self._window_ticks: list = []
+        self.span_epoch = 0
+        # Register as the dataflow's span barrier: any df-level state
+        # read sequences through sync_spans() automatically.
+        self._barrier = _ViewSpanBarrier(self)
+        dataflow._span_exec = self._barrier
         try:
             self.hydrate()
         except BaseException:
             self.expire()  # release reader holds of a failed build
             raise
+        self._dispatched = self._upper
 
     @property
     def upper(self) -> int:
@@ -447,7 +519,13 @@ class MaintainedView:
         if self.retain <= 0:
             self._since = t
             return
-        self._history.append((t, _host_updates(out)))
+        # The delta is retained DEVICE-RESIDENT (host conversion is
+        # lazy, on the rare AS OF rewind — _hist_host): recording
+        # history must not put a d2h readback on the per-tick hot
+        # path, or the pipelined span protocol's one-readback-per-span
+        # invariant breaks. SPMD deltas arrive as gathered host
+        # batches and convert for free.
+        self._history.append((t, out))
         while len(self._history) > self.retain:
             evicted_t, _ = self._history.pop(0)
             self._since = evicted_t
@@ -463,14 +541,18 @@ class MaintainedView:
                 "(string_agg/array_agg/list_agg): their digest "
                 "accumulators cannot be rewound"
             )
+        self.sync_spans()
         if not (self._since <= t < self._upper):
             raise AsOfError(
                 f"Timestamp ({t}) is not valid for all inputs: the "
                 f"readable window is [{self._since}, {self._upper})"
             )
         parts = [_host_updates(self.result_batch())]
-        for ht, (cols, nulls, htime, diff) in self._history:
+        for hi, (ht, _upd) in enumerate(self._history):
             if ht > t:
+                cols, nulls, htime, diff = _hist_host_at(
+                    self._history, hi
+                )
                 parts.append((cols, nulls, htime, np.negative(diff)))
         cols, nulls, _time, diff = IndexSource._concat(parts)
         return cols, nulls, np.full(len(diff), t, np.uint64), diff
@@ -590,7 +672,10 @@ class MaintainedView:
 
     def result_batch(self) -> Batch:
         """The maintained output arrangement as a HOST-readable batch
-        (SPMD dataflows gather their per-worker shards first)."""
+        (SPMD dataflows gather their per-worker shards first). Always
+        a COMMITTED span boundary: an in-flight pipelined span is
+        completed first."""
+        self.sync_spans()
         return self.df.gather_delta(self.df.output_batch())
 
     def _append_correction(self, out_upper: int, as_of: int) -> None:
@@ -754,6 +839,7 @@ class MaintainedView:
         (min over input uppers beyond our own): the micro-batch analog of
         frontier-joined progress. Returns False if the inputs did not
         advance within the timeout."""
+        self.sync_spans()
         lower = self.upper
         if not self.sources:
             # A source-less (pure constant) dataflow: one step at time 0
@@ -771,6 +857,7 @@ class MaintainedView:
             self._publish(0, out)
             self._record_history(0, out)
             self._upper = 1
+            self._dispatched = 1
             return True
         target = None
         for s in self.sources.values():
@@ -799,7 +886,210 @@ class MaintainedView:
         self._publish(t, out)
         self._record_history(t, out)
         self._upper = target
+        self._dispatched = target
         return True
+
+    # -- pipelined span stepping (ISSUE 7: the async control plane) --------
+    #
+    # The per-tick step() pays one flags readback per tick (run_steps'
+    # synchronous overflow check) and leaves the device idle while the
+    # host fetches the next chunk. step_span() processes up to
+    # span_max_ticks READY micro-batches as one deferred dispatch
+    # train and commits them with ONE boundary readback — overlapped,
+    # for index (sink-less) views, with the NEXT span's ingest and
+    # dispatch: the commit readback for span K runs after span K+1 is
+    # already queued on device (double buffering, at most one span in
+    # flight ahead of the committed frontier). Peeks, AS OF reads, and
+    # subscriber snapshots sequence against COMMITTED span boundaries
+    # via sync_spans() — they can never observe a half-applied carry.
+
+    def step_span(
+        self, max_ticks: int | None = None, timeout: float = 0.0
+    ) -> bool:
+        """Span-batched stepping. Sinked views commit synchronously at
+        the span boundary (durability needs the deltas host-side
+        anyway); index views pipeline (deferred commit). Views the
+        span protocol cannot cover — pure constants, basic-aggregate
+        sinks (per-step multiset captures), SPMD dataflows (host
+        gathers per tick) — fall back to the per-tick step."""
+        from ...render.dataflow import Dataflow as _SingleDevice
+        from ...utils.dyncfg import COMPUTE_CONFIGS, SPAN_MAX_TICKS
+
+        if max_ticks is None:
+            max_ticks = max(int(SPAN_MAX_TICKS(COMPUTE_CONFIGS)), 1)
+        if not self.sources or self._sink_finalizes:
+            return self.step(timeout)
+        if self.writer is None and type(self.df) is _SingleDevice:
+            # Index views pipeline: deferred commit, device-resident
+            # history, at most one span in flight.
+            return self._step_span_pipelined(max_ticks, timeout)
+        # Sinked views (durability reads deltas host-side anyway) and
+        # SPMD views (per-tick host gathers) commit synchronously at
+        # the span boundary — still one flags readback per span
+        # instead of one per tick.
+        return self._step_span_sync(max_ticks, timeout)
+
+    def _gather_ready_ticks(
+        self, lower: int, max_ticks: int, timeout: float
+    ) -> list:
+        """Up to max_ticks consecutive one-timestamp input chunks
+        beyond ``lower``: [(t, {name: batch})]. Only the FIRST tick
+        may wait ``timeout``; later ticks take whatever is already
+        ready (the span covers the backlog, it never stalls on it)."""
+        ticks: list = []
+        for k in range(max_ticks):
+            want = lower + k
+            target = None
+            for s in self.sources.values():
+                upper = s.reader.wait_for_upper(
+                    want, timeout if k == 0 else 0.0
+                )
+                if upper is None:
+                    target = None
+                    break
+                target = upper if target is None else min(target, upper)
+            if target is None:
+                break
+            target = min(target, want + 1)
+            polled = {
+                name: s.fetch_to(target)
+                for name, s in self.sources.items()
+            }
+            ticks.append((target - 1, polled))
+        return ticks
+
+    def _step_span_sync(self, max_ticks: int, timeout: float) -> bool:
+        """Sinked span: dispatch every ready tick asynchronously, ONE
+        flags readback (check_flags — replays on overflow), then the
+        per-tick durable appends from validated deltas."""
+        self.sync_spans()
+        lower = self.upper
+        ticks = self._gather_ready_ticks(lower, max_ticks, timeout)
+        if not ticks:
+            return False
+        if self.df.time != ticks[0][0]:
+            self.df.time = ticks[0][0]
+        deltas = self.df.run_steps(
+            [inp for _, inp in ticks], defer_check=True
+        )
+        if self.df.check_flags():
+            deltas = self.df.replayed_deltas
+        lo = lower
+        for (t, _), out in zip(ticks, deltas):
+            out = self.df.gather_delta(out)
+            self._append(out, lo, t + 1, t)
+            self._publish(t, out)
+            self._record_history(t, out)
+            lo = t + 1
+            self._upper = lo
+        self._dispatched = lo
+        self.span_epoch += 1
+        return True
+
+    def _step_span_pipelined(
+        self, max_ticks: int, timeout: float
+    ) -> bool:
+        """Index-view span: dispatch span K+1, then commit span K at
+        its boundary readback — the readback waits for K while K+1
+        executes. The committed frontier (`upper`, what peeks see)
+        trails the dispatched one by at most one span."""
+        from ...utils.dyncfg import COMPUTE_CONFIGS, SPAN_WINDOW_SPANS
+
+        lower = self._dispatched
+        ticks = self._gather_ready_ticks(lower, max_ticks, timeout)
+        if not ticks:
+            # No new input: drain the in-flight span so the committed
+            # frontier (and peeks waiting on it) still progresses.
+            return self._commit_inflight()
+        if (
+            len(self.df._defer_log)
+            >= int(SPAN_WINDOW_SPANS(COMPUTE_CONFIGS))
+        ):
+            # Rollback-window boundary: commit the in-flight span,
+            # then validate + clear the defer log (bounds replay
+            # memory). One extra readback per window, amortized; the
+            # pipeline refills on this very dispatch.
+            self.sync_spans()
+            if self.df._defer_ck is not None and self.df.check_flags():
+                self._recover_window()
+            self._window_ticks = []
+        if self.df._defer_ck is None:
+            self._window_ticks = []
+        if self.df.time != ticks[0][0]:
+            self.df.time = ticks[0][0]
+        # Our own dispatch must not self-sync through the registered
+        # span barrier (that would serialize the double buffer).
+        self._barrier.in_dispatch = True
+        try:
+            deltas = self.df.run_steps(
+                [inp for _, inp in ticks], defer_check=True
+            )
+        finally:
+            self._barrier.in_dispatch = False
+        snap = self.df.flags_snapshot()
+        entries = [(t, out) for (t, _), out in zip(ticks, deltas)]
+        self._window_ticks.extend(entries)
+        prev = self._inflight_span
+        self._inflight_span = (snap, entries, ticks[-1][0] + 1)
+        self._dispatched = ticks[-1][0] + 1
+        if prev is not None:
+            self._commit_span(prev)
+        return True
+
+    def _commit_span(self, handle) -> None:
+        """The span boundary: ONE fused flags readback; clean commits
+        publish the span's deltas (device handoff), record history,
+        and advance the committed frontier; an overflow triggers the
+        whole-window rollback+replay."""
+        snap, entries, target = handle
+        if self.df.read_flags_snapshot(snap):
+            self._recover_window()
+            return
+        for t, out in entries:
+            self._publish(t, out)
+            self._record_history(t, out)
+            self._upper = t + 1
+        self.span_epoch += 1
+
+    def _commit_inflight(self) -> bool:
+        handle, self._inflight_span = self._inflight_span, None
+        if handle is None:
+            return False
+        self._commit_span(handle)
+        return True
+
+    def _recover_window(self) -> None:
+        """An overflow rolled the defer window back and replayed it
+        against grown tiers (render/dataflow.check_flags). Spans
+        committed earlier in the window were validated clean at their
+        own boundary — the replay reproduces their deltas identically
+        (steps are pure) — so only the uncommitted tail publishes."""
+        if self.df._defer_ck is not None:
+            self.df.check_flags()
+        replayed = getattr(self.df, "replayed_deltas", [])
+        for (t, _old), out in zip(self._window_ticks, replayed):
+            if t >= self._upper:
+                self._publish(t, out)
+                self._record_history(t, out)
+                self._upper = t + 1
+        self._upper = max(self._upper, self._dispatched)
+        self._inflight_span = None
+        self._window_ticks = []
+        self.span_epoch += 1
+
+    def sync_spans(self) -> None:
+        """The read barrier: complete + commit the in-flight span, so
+        callers (peeks, AS OF reads, subscriber snapshots, DML)
+        observe a committed span boundary — never a half-applied
+        carry. No-op when nothing is in flight, and exactly ONE
+        readback otherwise: the boundary commit's clean snapshot
+        already proves every span <= it valid (flags OR-accumulate),
+        so the serving path never pays a second validation round trip
+        — window teardown happens at the span loop's own boundary
+        (_step_span_pipelined) or inside df.check_flags when a
+        df-level reader forces it."""
+        if self._inflight_span is not None:
+            self._commit_inflight()
 
     def _publish(self, t: int, out: Batch) -> None:
         """Push this step's output delta to index-import subscribers
@@ -828,4 +1118,5 @@ class MaintainedView:
                 )
 
     def peek(self) -> list[tuple]:
+        self.sync_spans()
         return self.df.peek()

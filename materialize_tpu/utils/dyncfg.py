@@ -201,6 +201,49 @@ PEEK_TS_CACHE_MS = Config(
     "concurrency (reads within one serving tick share a timestamp)",
 ).register(COMPUTE_CONFIGS)
 
+# -- the async pipelined control plane (ISSUE 7 / ROADMAP item 4) ------------
+
+SPAN_PIPELINING = Config(
+    "span_pipelining", True,
+    "replica worker loop: step maintained views in SPANS of up to "
+    "span_max_ticks ready micro-batches with deferred overflow checks "
+    "— the span's ticks dispatch asynchronously and the span commits "
+    "with ONE flags readback, overlapped with the NEXT span's ingest "
+    "and dispatch (double-buffered: at most one span in flight ahead "
+    "of the committed frontier). Off = the per-tick step loop (one "
+    "readback per tick)",
+).register(COMPUTE_CONFIGS)
+
+SPAN_MAX_TICKS = Config(
+    "span_max_ticks", 8,
+    "max ready micro-batches dispatched per replica span; the span "
+    "commit (frontier advance, subscriber publish, history record) "
+    "happens once per span at the boundary readback",
+).register(COMPUTE_CONFIGS)
+
+SPAN_WINDOW_SPANS = Config(
+    "span_window_spans", 16,
+    "pipelined spans per rollback window: the deferred-overflow "
+    "checkpoint and input log are retained across this many committed "
+    "spans, then validated and cleared (bounds replay memory; the "
+    "boundary validation is the window's one extra sync point)",
+).register(COMPUTE_CONFIGS)
+
+SPAN_DONATION = Config(
+    "span_donation", "auto",
+    "donate the span program's carry (operator states, output spine, "
+    "err arrangement, device time) to XLA so each span's outputs "
+    "reuse the previous span's state buffers instead of allocating + "
+    "copying state-sized arrays per dispatch. 'auto' = on for TPU "
+    "backends; 'off' forces off; 'on' forces on WHERE the backend "
+    "honors donation (CPU ignores donate_argnums, and jaxlib crashes "
+    "lowering large donated programs on the forced multi-device host "
+    "platform; reported state always reflects the EFFECTIVE value). "
+    "The rollback checkpoint is CLONED to fresh buffers before the "
+    "first donated dispatch of a window — donated buffers are never "
+    "read back",
+).register(COMPUTE_CONFIGS)
+
 TRANSIENT_PEEK_CACHE = Config(
     "transient_peek_cache", 8,
     "memoize slow-path SELECT dataflows by description fingerprint: "

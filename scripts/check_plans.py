@@ -286,6 +286,15 @@ def run_bench_mode(verbose: bool) -> int:
                     lint_jaxpr(pclosed),
                     kernel_count(pclosed),
                 )
+    # The pipelined control plane's host-sync gate (ISSUE 7): an
+    # accidental d2h sync point (np.asarray / .item() /
+    # block_until_ready / un-donated device_put) on the per-span hot
+    # path fails statically — it would serialize the span pipeline
+    # and reintroduce the per-span RTT tax.
+    from materialize_tpu.analysis import lint_hot_path
+
+    hs = lint_hot_path()
+    gate("host-sync-hot-path", None, hs, 0)
     return rc
 
 

@@ -492,10 +492,15 @@ def test_slotted_delta_join_matches_merge_mode():
             acc[r[:-2]] = acc.get(r[:-2], 0) + r[-1]
         return {k: d for k, d in acc.items() if d}, slotted
 
-    # state_ingest_mode auto resolves to merge (the reference
-    # semantics); the dyncfg flips the SAME dataflow's state spines to
-    # the append-slot ring.
-    want, was_slotted = drive(1 << 13)
+    # Pin the baseline arm to merge explicitly (auto now resolves
+    # big-state operator spines to the slot ring — ISSUE 7 satellite);
+    # the dyncfg then flips the SAME dataflow's state spines to the
+    # append-slot ring.
+    COMPUTE_CONFIGS.update({"arrangement_ingest_mode": "merge"})
+    try:
+        want, was_slotted = drive(1 << 13)
+    finally:
+        COMPUTE_CONFIGS.update({"arrangement_ingest_mode": None})
     assert not was_slotted
     COMPUTE_CONFIGS.update({"arrangement_ingest_mode": "append_slot"})
     try:
@@ -521,9 +526,11 @@ def test_ingest_mode_decision():
     assert ingest_mode(1 << 21) == "append_slot"
     assert ingest_mode(8 * 1024) == "append_slot"
     assert ingest_mode(8 * 1024 - 1) == "merge"
-    # Operator-state spines: conservative auto (see state_ingest_mode
-    # docstring), dyncfg override respected.
-    assert state_ingest_mode(1 << 21) == "merge"
+    # Operator-state spines now follow the same big-state auto rule
+    # (the ISSUE 7 satellite paid off the round-6 deferral: tiers were
+    # regenerated on this host with slotted state spines).
+    assert state_ingest_mode(1 << 21) == "append_slot"
+    assert state_ingest_mode(8 * 1024 - 1) == "merge"
     COMPUTE_CONFIGS.update({"arrangement_ingest_mode": "merge"})
     try:
         assert ingest_mode(1 << 21) == "merge"

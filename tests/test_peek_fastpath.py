@@ -368,3 +368,89 @@ def test_drop_index_with_cached_transient_importing_it(coord):
     assert coord._transient_cache
     coord.execute("DROP INDEX ti")  # must not raise
     assert not coord._transient_cache
+
+
+# -- peek timestamp sequencing under pipelined ticks (ISSUE 7) ---------------
+
+
+def test_peek_reads_committed_boundary_under_pipelined_ticks(coord):
+    """End to end with span pipelining on (the default): every
+    strict-mode fast-path lookup admitted while the replica pipelines
+    spans observes exactly the data at a committed span boundary
+    covering the write it waited for — never a torn/half-applied
+    carry, never a stale pre-write frontier."""
+    coord.execute("CREATE TABLE s (k BIGINT, v BIGINT)")
+    coord.execute("CREATE VIEW sv AS SELECT * FROM s")
+    coord.execute("CREATE INDEX si ON sv")
+    written = []
+    for i in range(12):
+        coord.execute(f"INSERT INTO s VALUES ({i % 4}, {i})")
+        written.append((i % 4, i))
+        rows = [tuple(r) for r in coord.fast_peek_values("sv", (i % 4,), (0,))]
+        # Strict timestamp selection (peek_ts_cache_ms = 0) is
+        # linearizable w.r.t. the write: the row just inserted must be
+        # visible, along with every earlier row of that key and
+        # nothing else.
+        expect = sorted(r for r in written if r[0] == i % 4)
+        assert sorted(rows) == expect, f"tick {i}: torn read"
+    # The replica reported monotone span epochs alongside frontiers.
+    deadline = 50
+    while coord.controller.span_epoch("si") == 0 and deadline:
+        import time as _t
+
+        _t.sleep(0.02)
+        deadline -= 1
+    assert coord.controller.span_epoch("si") > 0
+
+
+def test_midflight_peek_sequences_to_span_boundary(tmp_path):
+    """Surgical (MaintainedView level): with a span DISPATCHED but not
+    committed, a peek must first commit the boundary — the committed
+    frontier, the served rows, and the span epoch advance together."""
+    import numpy as np
+
+    from materialize_tpu.expr import relation as mir
+    from materialize_tpu.render.dataflow import Dataflow
+    from materialize_tpu.repr.schema import Column, ColumnType, Schema
+    from materialize_tpu.storage.persist import MaintainedView
+
+    SCH = Schema(
+        (Column("k", ColumnType.INT64), Column("v", ColumnType.INT64))
+    )
+    client = PersistClient(
+        FileBlob(str(tmp_path / "blob2")),
+        SqliteConsensus(str(tmp_path / "c2.db")),
+    )
+    w = client.open_writer("src", SCH)
+    view = MaintainedView(
+        client,
+        Dataflow(mir.Get("src", SCH), out_slots=0),
+        {"src": ("src", SCH)},
+        None,
+    )
+    for t in range(8):
+        k = np.arange(4, dtype=np.int64)
+        v = np.full(4, t, dtype=np.int64)
+        w.compare_and_append(
+            [k, v], [None, None],
+            np.full(4, t, np.uint64), np.ones(4, np.int64), t, t + 1,
+        )
+    # First span dispatch: committed frontier trails the dispatched one
+    # (double buffering — the span is in flight, uncommitted).
+    assert view.step_span(max_ticks=4, timeout=5)
+    assert view._dispatched > view.upper, "no span actually in flight"
+    epoch0 = view.span_epoch
+    rows = view.peek()  # the read barrier commits the boundary first
+    assert view.upper == view._dispatched
+    assert view.span_epoch > epoch0
+    # The served rows are exactly the committed boundary's content.
+    got = {}
+    for r in rows:
+        got[r[:-2]] = got.get(r[:-2], 0) + r[-1]
+    expect = {
+        (int(k), int(t)): 1
+        for t in range(view.upper)
+        for k in range(4)
+    }
+    assert {k: d for k, d in got.items() if d} == expect
+    view.expire()
