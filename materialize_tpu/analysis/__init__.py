@@ -23,10 +23,41 @@ Three passes:
   accidental host sync points (np.asarray / .item() /
   block_until_ready / un-donated device_put) — the pipelined control
   plane's one-readback-per-span invariant, enforced statically.
+- ``provenance`` / ``donation``: buffer-provenance scan over the
+  render-layer state trees (per device leaf: span-carry-owned /
+  shared-across-dataflows / host-retained / cache-retained + the
+  sharing graph), the donation-safety prover gating the replica's
+  donated ``run_steps`` span train, the runtime use-after-donate
+  sanitizer (dyncfg ``buffer_sanitizer``), and the static
+  cross-checks (lowered input_output_aliases, donated-leaf-reuse AST
+  rule).
 
 See doc/analysis.md for the catalogue of invariants and lints.
 """
 
+from .donation import (  # noqa: F401
+    LEDGER,
+    UNSOUND_DONATION,
+    USE_AFTER_DONATE,
+    DonationVerdict,
+    UseAfterDonateError,
+    dataflow_verdict,
+    donation_lowering_findings,
+    guard_read,
+    lint_donated_reuse,
+    record_donated,
+    view_verdict,
+)
+from .provenance import (  # noqa: F401
+    PROV_CACHE,
+    PROV_CARRY,
+    PROV_HOST,
+    PROV_SHARED,
+    ProvenanceReport,
+    scan_dataflow,
+    scan_replica,
+    scan_view,
+)
 from .jaxpr_lint import (  # noqa: F401
     LintFinding,
     intermediate_bytes,

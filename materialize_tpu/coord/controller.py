@@ -78,8 +78,10 @@ class PeekBatcher:
     the serial baseline ``bench.py --serve`` compares against."""
 
     def __init__(self, controller: "ComputeController"):
+        from ..utils.lockcheck import tracked_lock
+
         self.ctrl = controller
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("controller.peek_batcher")
         self._groups: dict = {}  # (df, bound_cols, scan) -> [waiters]
         self._queued = 0
         self._inflight = 0
@@ -441,7 +443,9 @@ class ComputeController:
         # (a dropped dataflow disappears entirely: history.rs compaction).
         self._dataflows: dict[str, dict] = {}
         self._config: dict = {}
-        self._lock = threading.Lock()
+        from ..utils.lockcheck import tracked_lock
+
+        self._lock = tracked_lock("controller.state")
         # Observed state (guarded by _lock: mutated by the absorber
         # thread, read by caller threads).
         self.frontiers: dict[str, dict[str, int]] = {}  # df -> replica -> upper
@@ -452,6 +456,11 @@ class ComputeController:
         # not individual ticks — the counter is the observable identity
         # of a boundary.
         self.span_epochs: dict[str, dict[str, int]] = {}
+        # Buffer-provenance/donation verdicts (ISSUE 8, df -> replica
+        # -> verdict dict): the prover's per-carry-argnum donation
+        # safety each replica reports whenever it changes. Surfaced by
+        # EXPLAIN ANALYSIS and the mz_donation introspection relation.
+        self.donation_verdicts: dict[str, dict[str, dict]] = {}
         self.statuses: deque = deque(maxlen=1000)  # replica error reports
         # Install acks: df name -> replica -> error string | None (ok).
         self.install_acks: dict[str, dict] = {}
@@ -510,6 +519,8 @@ class ComputeController:
                 per_df.pop(name, None)
             for per_df in self.span_epochs.values():
                 per_df.pop(name, None)
+            for per_df in self.donation_verdicts.values():
+                per_df.pop(name, None)
 
     def _history_snapshot(self):
         with self._lock:
@@ -567,6 +578,7 @@ class ComputeController:
             self.frontiers.pop(name, None)
             self.arrangement_records.pop(name, None)
             self.span_epochs.pop(name, None)
+            self.donation_verdicts.pop(name, None)
             self.install_acks.pop(name, None)
         self._broadcast(ctp.drop_dataflow(name))
 
@@ -658,6 +670,10 @@ class ComputeController:
                             self.span_epochs.setdefault(df, {})[
                                 replica
                             ] = e
+                        for df, v in msg.get("donation", {}).items():
+                            self.donation_verdicts.setdefault(df, {})[
+                                replica
+                            ] = v
             elif kind == "Status":
                 with self._lock:
                     self.statuses.append(msg)

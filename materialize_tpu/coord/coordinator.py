@@ -133,7 +133,13 @@ class Coordinator:
         # retractions): the DictExhausted replan-retry in execute() is
         # only safe when the failed attempt left no net durable state.
         self._net_durable = 0
-        self._lock = threading.RLock()
+        # Tracked for the lock-order sanitizer (utils/lockcheck,
+        # `-m analysis`): THE sequencing lock — holding it across a
+        # device dispatch or against the controller locks in reverse
+        # order is exactly what the sanitizer exists to catch.
+        from ..utils.lockcheck import tracked_rlock
+
+        self._lock = tracked_rlock("coord.sequencing", sequencing=True)
         # Introspection relations (mz_internal analog): virtual items
         # resolved to snapshots at peek time (introspection.py).
         from .introspection import INTROSPECTION_SCHEMAS
@@ -172,6 +178,51 @@ class Coordinator:
     # -- replicas -----------------------------------------------------------
     def add_replica(self, name: str, addr) -> None:
         self.controller.add_replica(name, addr)
+
+    def _donation_analysis_text(self) -> str:
+        """Provenance/donation verdicts for every installed
+        catalog-named dataflow (the EXPLAIN ANALYSIS live block;
+        mz_donation serves ALL installed dataflows relationally,
+        transient-SELECT cache installs included — those carry
+        session-scoped generated names, which would make EXPLAIN
+        output nondeterministic). A dataflow whose replica has not
+        reported a verdict yet prints as pending rather than being
+        omitted — the surface always covers the full install set."""
+        named = {it.name for it in self.catalog.items.values()}
+        named |= set(self.peekable.values())
+        with self.controller._lock:
+            installed = sorted(
+                n for n in self.controller._dataflows if n in named
+            )
+            verdicts = {
+                df: dict(per)
+                for df, per in (
+                    self.controller.donation_verdicts.items()
+                )
+            }
+        lines = ["donation:"]
+        if not installed:
+            lines.append("  (no dataflows installed)")
+        for name in installed:
+            per = verdicts.get(name)
+            if not per:
+                lines.append(
+                    f"  {name}: pending (no replica verdict yet)"
+                )
+                continue
+            for rep, v in sorted(per.items()):
+                from ..analysis.donation import verdict_display
+
+                donated, prov = verdict_display(v)
+                lines.append(
+                    f"  {name}@{rep}: "
+                    f"safe={str(bool(v.get('safe'))).lower()} "
+                    f"requested="
+                    f"{str(bool(v.get('requested'))).lower()} "
+                    f"wired={str(bool(v.get('wired'))).lower()} "
+                    f"donated=[{donated}] provenance({prov})"
+                )
+        return "\n".join(lines)
 
     # -- durable catalog ----------------------------------------------------
     def _catalog_append(self, record: dict, diff: int) -> None:
@@ -333,8 +384,15 @@ class Coordinator:
         if isinstance(plan, DropPlan):
             return self._sequence_drop(plan)
         if isinstance(plan, ExplainPlan):
+            text = plan.text
+            if plan.stage == "analysis":
+                # The LIVE half of EXPLAIN ANALYSIS (ISSUE 8): the
+                # buffer-provenance / donation-safety verdict of every
+                # INSTALLED dataflow, as last reported by the replicas
+                # (the plan-side half above is static and catalog-only).
+                text = text + "\n" + self._donation_analysis_text()
             return ExecuteResult(
-                "text", text=plan.text, columns=("explain",)
+                "text", text=text, columns=("explain",)
             )
         if isinstance(plan, ShowPlan):
             kind = plan.kind.lower().rstrip("s")  # sources -> source
@@ -1353,9 +1411,16 @@ class Coordinator:
                 return e
             return _rewrite_children(e, subst)
 
-        df = Dataflow(subst(expr))
-        df.step({})
-        rows = _decode_peek_rows(df.output_batch(), df)
+        from ..utils.lockcheck import allow_dispatch
+
+        with allow_dispatch("introspection constants"):
+            # Sanctioned dispatch under the sequencing lock: the plan
+            # is pure Constants over coordinator snapshots — bounded
+            # rows, no source waits (lockcheck dispatch-under-lock
+            # rule would otherwise flag it).
+            df = Dataflow(subst(expr))
+            df.step({})
+            rows = _decode_peek_rows(df.output_batch(), df)
         return ExecuteResult(
             "rows",
             rows=_finish(rows, plan.order_by,

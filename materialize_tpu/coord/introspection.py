@@ -48,6 +48,17 @@ INTROSPECTION_SCHEMAS: dict[str, Schema] = {
             Column("span_epoch", I),
         ]
     ),
+    "mz_donation": Schema(
+        [
+            Column("dataflow", S),
+            Column("replica", S),
+            Column("safe", I),
+            Column("requested", I),
+            Column("wired", I),
+            Column("donated", S),
+            Column("provenance", S),
+        ]
+    ),
     "mz_metrics": Schema(
         [Column("metric", S), Column("value", F)]
     ),
@@ -134,6 +145,37 @@ def snapshot(coord, name: str) -> list[tuple]:
             for df, per in sorted(snap.items())
             for rep, e in sorted(per.items())
         ]
+    if name == "mz_donation":
+        # The buffer-provenance prover's verdicts (ISSUE 8): per
+        # (dataflow, replica), whether the run_steps span train's
+        # carry is provably donatable, which parts actually donate
+        # (requested && safe), whether the backend wires the argnums,
+        # and the provenance class census of the scanned state tree.
+        with coord.controller._lock:
+            snap = {
+                df: dict(per)
+                for df, per in (
+                    coord.controller.donation_verdicts.items()
+                )
+            }
+        from ..analysis.donation import verdict_display
+
+        rows = []
+        for df, per in sorted(snap.items()):
+            for rep, v in sorted(per.items()):
+                donated, prov = verdict_display(v)
+                rows.append(
+                    (
+                        _enc(df),
+                        _enc(rep),
+                        int(bool(v.get("safe"))),
+                        int(bool(v.get("requested"))),
+                        int(bool(v.get("wired"))),
+                        _enc(donated),
+                        _enc(prov),
+                    )
+                )
+        return rows
     if name == "mz_metrics":
         from ..utils.metrics import REGISTRY
 

@@ -187,18 +187,28 @@ def update_configuration(params: dict) -> dict:
 
 
 def frontiers(
-    uppers: dict, records: dict, span_epochs: dict, replica_id: str
+    uppers: dict,
+    records: dict,
+    span_epochs: dict,
+    replica_id: str,
+    donation: dict | None = None,
 ) -> dict:
     """Replica -> controller frontier report. ``span_epochs`` carries
     each dataflow's monotone COMMITTED span counter (ISSUE 7: the
     pipelined control plane commits frontiers once per span, and
     peeks/compaction sequence against span boundaries — the counter
     is the boundary identity a coordinator can reason about without
-    another round trip)."""
-    return {
+    another round trip). ``donation`` piggybacks each dataflow's
+    buffer-provenance/donation verdict (ISSUE 8) whenever it changed —
+    the EXPLAIN ANALYSIS and mz_donation surface, shipped only on
+    change so steady state pays nothing."""
+    msg = {
         "kind": "Frontiers",
         "uppers": uppers,
         "records": records,
         "span_epochs": span_epochs,
         "replica_id": replica_id,
     }
+    if donation:
+        msg["donation"] = donation
+    return msg

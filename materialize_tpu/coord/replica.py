@@ -120,7 +120,9 @@ class ReplicaWorker:
         # invalidates our device-resident codes too: queue the remaps
         # and rebuild from the worker loop (single-threaded owner).
         self._pending_remaps: list[dict] = []
-        self._remap_lock = threading.Lock()
+        from ..utils.lockcheck import tracked_lock
+
+        self._remap_lock = tracked_lock("replica.remap")
 
         def _on_rebalance(remap, _self=self):
             with _self._remap_lock:
@@ -879,6 +881,7 @@ class ReplicaWorker:
         changed = {}
         records = {}
         epochs = {}
+        donation = {}
         for name, inst in self.dataflows.items():
             upper = inst.view.upper
             if upper != inst.reported_upper:
@@ -896,11 +899,21 @@ class ReplicaWorker:
                 import numpy as _np
 
                 records[name] = inst.view.df.output_records()
-        if changed:
+            # Buffer-provenance/donation verdicts (ISSUE 8) ride the
+            # frontier report, but only when the verdict CHANGED (a
+            # new subscriber, a dyncfg flip): steady state ships
+            # nothing extra.
+            if inst.view._donation_dirty:
+                info = inst.view.donation_info()
+                if info is not None:
+                    donation[name] = info
+                inst.view._donation_dirty = False
+        if changed or donation:
             ctp.send_msg(
                 conn,
                 ctp.frontiers(
-                    changed, records, epochs, self.replica_id
+                    changed, records, epochs, self.replica_id,
+                    donation=donation,
                 ),
             )
             return True

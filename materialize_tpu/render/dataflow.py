@@ -1015,6 +1015,14 @@ class _DataflowBase:
         self._defer_log: list = []
         self._defer_flags = None
         self._defer_cflags = None
+        # Donation bookkeeping for the CURRENT defer window (ISSUE 8):
+        # which carry parts ride donated dispatches (the provenance
+        # prover's unsound-donation check reads this), and whether the
+        # window checkpoint is a fresh-buffer clone (a donated window
+        # with a plain reference checkpoint would resurrect dead
+        # buffers on rollback).
+        self._defer_donated: tuple = ()
+        self._defer_ck_cloned = False
         # Spine-compaction schedule (differential's geometric spine-
         # merge budget): every `_compact_every` steps, fold level 0 of
         # every spine into level 1; every `_compact_every *
@@ -1482,6 +1490,9 @@ class _DataflowBase:
         is a full cascade). Async like steps; returns its packed
         per-target-run overflow flags (key order: self._covf_keys —
         uniform across variants; untouched levels pack False)."""
+        from ..utils.lockcheck import device_dispatch
+
+        device_dispatch("_dispatch_compact")
         jitfn = self._compact_jits.get(max_level)
         if jitfn is None:
             jitfn = self._make_compact_jit(max_level)
@@ -1536,14 +1547,40 @@ class _DataflowBase:
             return fl
         return jnp.logical_or(acc, fl)
 
-    def _dispatch_span(self, packed: list, env):
+    def _dispatch_span(self, packed: list, env, donate: tuple = ()):
         """Asynchronously dispatch one step per packed input, plus the
         scheduled spine compactions. ZERO host transfers: time rides as
         a device scalar (created once per dataflow), overflow flags
         accumulate as a running on-device logical_or for the caller to
-        check. Returns (deltas, step-flag OR, compaction-flag OR)."""
+        check. Returns (deltas, step-flag OR, compaction-flag OR).
+
+        ``donate`` names the carry parts handed to the step program's
+        ``donate_argnums`` (the prover-approved subset): each step then
+        writes its output carry into the previous step's buffers
+        instead of allocating state-sized arrays per dispatch. The
+        killed leaves are recorded in the sanitizer ledger — dead the
+        moment the dispatch returns."""
+        from ..utils.lockcheck import device_dispatch
+
+        device_dispatch("_dispatch_span")
         if self._time_dev is None:
             self._time_dev = jnp.asarray(self.time, dtype=jnp.uint64)
+        step_fn = self._step_jit
+        record = None
+        if donate:
+            from ..analysis.donation import (
+                LEDGER,
+                STEP_ARGNUM as part_arg,
+                sanitizer_enabled,
+            )
+
+            if _donation_supported():
+                step_fn = self._donated_step_program(tuple(donate))
+            # Resolve the sanitizer ONCE per dispatch train: with it
+            # off (the production default) the hot loop must not pay
+            # per-tick ledger-argument construction or a dyncfg-lock
+            # read. The contract still holds on any backend when on.
+            record = LEDGER.record if sanitizer_enabled() else None
         deltas, flags_or, cflags_or = [], None, None
         for p in packed:
             args = (
@@ -1555,17 +1592,23 @@ class _DataflowBase:
             )
             if env is not None:
                 out, new_states, new_output, new_err, new_t, fl = (
-                    self._step_jit(*args, env)
+                    step_fn(*args, env)
                 )
             else:
                 out, new_states, new_output, new_err, new_t, fl = (
-                    self._step_jit(*args)
+                    step_fn(*args)
                 )
             self.states = list(new_states)
             self.output = new_output
             self.err_output = new_err
             self._time_dev = new_t
             self._time += 1  # direct: keep the device carry live
+            if record is not None:
+                record(
+                    tuple(args[part_arg[part]] for part in donate),
+                    f"{self.name}.run_steps step t={self._time - 1} "
+                    f"(donated {','.join(donate)})",
+                )
             deltas.append(out)
             flags_or = self._or_acc(flags_or, fl)
             self._compact_tick += 1
@@ -1648,7 +1691,12 @@ class _DataflowBase:
             )
         )
 
-    def run_steps(self, inputs_list: list, defer_check: bool = False) -> list:
+    def run_steps(
+        self,
+        inputs_list: list,
+        defer_check: bool = False,
+        donate=False,
+    ) -> list:
         """Feed several micro-batches with deferred overflow handling:
         all steps are submitted asynchronously and the packed overflow
         flags are read once at the end of the span; on overflow the
@@ -1663,12 +1711,26 @@ class _DataflowBase:
         ``run_steps``). Until then the span's inputs stay referenced so
         an overflow discovered later can still roll back and replay.
 
+        ``donate`` hands carry parts to the step program's
+        ``donate_argnums`` — True for the whole carry, or a tuple of
+        part names from ``analysis.provenance.CARRY_PARTS`` (the
+        prover's per-argnum verdict). The donation CONTRACT (cloned
+        window checkpoint, ledger record of the killed leaves) engages
+        whenever donation is REQUESTED; the argnums themselves narrow
+        to backends that honor donation (_donation_supported — the one
+        shared predicate). Callers must decide donation at a fresh
+        defer window: a window that started with a plain-reference
+        checkpoint keeps its spans un-donated (rollback would
+        resurrect dead buffers otherwise).
+
         CAVEAT: deltas returned from a deferred span are PROVISIONAL —
         if a tier overflowed they were computed against truncated
         arrangements. Do not feed them to a sink until
         :meth:`check_flags` returns False; when it returns True, the
         corrected per-step deltas of the replay are available on
         ``self.replayed_deltas`` (in dispatch order)."""
+        from ..analysis.provenance import CARRY_PARTS
+
         self.span_barrier()
         if getattr(self, "_first_time", None) is None:
             # The dataflow's as_of: the first processed timestamp
@@ -1676,12 +1738,40 @@ class _DataflowBase:
             self._first_time = int(self.time)
             self._ctx.first_time = self._first_time
         self._check_slot_ring()
+        parts = (
+            tuple(CARRY_PARTS)
+            if donate is True
+            else tuple(donate or ())
+        )
         packed = [self._pack_inputs(i) for i in inputs_list]
         env = self._build_env()
+        if parts:
+            from ..analysis.donation import guard_read
+
+            # Re-dispatching a donated buffer as an operand is itself
+            # a use-after-donate (sanitizer-gated, no-op when off).
+            guard_read(packed, f"{self.name}.run_steps operands")
         if defer_check:
             if self._defer_ck is None:
-                self._defer_ck = self._checkpoint()
-            deltas, flags_or, cflags_or = self._dispatch_span(packed, env)
+                self._defer_ck = (
+                    self._clone_checkpoint()
+                    if parts
+                    else self._checkpoint()
+                )
+                self._defer_ck_cloned = bool(parts)
+            elif parts and not self._defer_ck_cloned:
+                # Mid-window donation flip with a plain reference
+                # checkpoint: donating now could resurrect dead
+                # buffers on rollback. Stay un-donated until the
+                # window turns over (the view re-decides there).
+                parts = ()
+            if parts:
+                self._defer_donated = tuple(
+                    sorted(set(self._defer_donated) | set(parts))
+                )
+            deltas, flags_or, cflags_or = self._dispatch_span(
+                packed, env, donate=parts
+            )
             self._defer_log.append((packed, env))
             if flags_or is not None:
                 self._defer_flags = self._or_acc(
@@ -1694,8 +1784,12 @@ class _DataflowBase:
             return deltas
         self.check_flags()
         while True:
-            ck = self._checkpoint()
-            deltas, flags, cflags = self._dispatch_span(packed, env)
+            ck = (
+                self._clone_checkpoint() if parts else self._checkpoint()
+            )
+            deltas, flags, cflags = self._dispatch_span(
+                packed, env, donate=parts
+            )
             over = self._overflowed_keys(flags, cflags)
             if over:
                 self._restore(ck)
@@ -1882,7 +1976,10 @@ class _DataflowBase:
         check_flags). ``donate`` hands the carry's buffers to the span
         program (see _make_span_jit); the defer checkpoint is then a
         fresh-buffer clone."""
+        from ..utils.lockcheck import device_dispatch
+
         self.span_barrier()
+        device_dispatch("run_span")
         ce = self._compact_every
         if len(inputs_list) % ce != 0:
             raise ValueError(
@@ -1902,6 +1999,12 @@ class _DataflowBase:
             self._defer_ck = (
                 self._clone_checkpoint() if donate else self._checkpoint()
             )
+            self._defer_ck_cloned = bool(donate)
+        elif donate and not self._defer_ck_cloned:
+            # A window that started with a plain reference checkpoint
+            # cannot start donating mid-window: rollback would
+            # resurrect buffers a donated dispatch killed.
+            donate = False
         if self._compact_tick % ce:
             # Flush (full cascade) so the span's internal compaction
             # schedule starts from a clean counter.
@@ -1925,6 +2028,7 @@ class _DataflowBase:
         )
         if not hasattr(self, "_span_jits"):
             self._span_jits = {}
+        requested = bool(donate)
         donate = donate and _donation_supported()
         key = (ce, n_chunks, env is not None, donate)
         jitfn = self._span_jits.get(key)
@@ -1946,6 +2050,21 @@ class _DataflowBase:
         # the CPU "donated buffers were not usable" warning is
         # unreachable here by construction.
         carry, deltas, sfl, cfl = jitfn(*args)
+        if requested:
+            # The donation CONTRACT is backend-independent: whenever a
+            # span is dispatched with donation requested, the old
+            # carry is dead — record it so the sanitizer catches any
+            # holder that reads it (even on backends where the argnums
+            # were not wired and the buffers happen to survive).
+            from ..analysis.donation import record_donated
+            from ..analysis.provenance import CARRY_PARTS
+
+            record_donated(
+                args[:4],
+                f"{self.name}.run_span span@t={self.time} (donated "
+                "carry)",
+            )
+            self._defer_donated = tuple(CARRY_PARTS)
         st, o, e, t = carry
         self.states = list(st)
         self.output = o
@@ -1973,6 +2092,8 @@ class _DataflowBase:
         if self._defer_flags is None and self._defer_cflags is None:
             self._defer_ck = None
             self._defer_log = []
+            self._defer_donated = ()
+            self._defer_ck_cloned = False
             return False
         over = self._overflowed_keys(self._defer_flags, self._defer_cflags)
         log = self._defer_log
@@ -1980,6 +2101,8 @@ class _DataflowBase:
         self._defer_log = []
         self._defer_flags, self._defer_cflags = None, None
         self._defer_ck = None
+        self._defer_donated = ()
+        self._defer_ck_cloned = False
         if not over:
             return False
         self._restore(ck)
@@ -2119,6 +2242,7 @@ class Dataflow(_DataflowBase):
         # keep the 4-argument signature (and their compile-cache
         # entries).
         self._span_jits = {}
+        self._donated_step_jits = {}
         if self._str_keys:
             self._step_jit = jax.jit(
                 lambda s, o, eo, i, t, env: self._step_core(
@@ -2129,6 +2253,38 @@ class Dataflow(_DataflowBase):
             self._step_jit = jax.jit(
                 lambda s, o, eo, i, t: self._step_core(s, o, eo, i, t)
             )
+
+    def _donated_step_program(self, parts: tuple):
+        """The step jit with ``donate_argnums`` on the prover-approved
+        carry parts (the replica's donated ``run_steps`` span train,
+        ISSUE 8): each step's output carry reuses the previous step's
+        buffers instead of allocating state-sized arrays per tick.
+        Cached per part subset; inputs (argnum 3) are never donated —
+        the defer log replays them on overflow."""
+        from ..analysis.donation import STEP_ARGNUM
+
+        parts = tuple(sorted(parts))
+        jitfn = self._donated_step_jits.get(parts)
+        if jitfn is None:
+            argnums = tuple(
+                sorted(STEP_ARGNUM[p] for p in parts)
+            )
+            if self._str_keys:
+                jitfn = jax.jit(
+                    lambda s, o, eo, i, t, env: self._step_core(
+                        s, o, eo, i, t, env
+                    ),
+                    donate_argnums=argnums,
+                )
+            else:
+                jitfn = jax.jit(
+                    lambda s, o, eo, i, t: self._step_core(
+                        s, o, eo, i, t
+                    ),
+                    donate_argnums=argnums,
+                )
+            self._donated_step_jits[parts] = jitfn
+        return jitfn
 
     def _grow_batch(self, b: Batch, target: int | None = None) -> Batch:
         cap = target if target is not None else b.capacity * 2
@@ -2457,6 +2613,16 @@ class ShardedDataflow(_DataflowBase):
             "one dispatch per step, and its packed flags ride the "
             "same deferred logical_or accumulator) — see ROADMAP "
             "item 2 for the sharded slot-ring/span design"
+        )
+
+    def _donated_step_program(self, parts: tuple):
+        raise NotImplementedError(
+            "SPMD dataflows do not donate their carry: the per-worker "
+            "shard layout rides shard_map boundary specs that "
+            "donate_argnums cannot alias through (and the slot-cursor "
+            "limitation of ROADMAP item 2 keeps SPMD on merge ingest "
+            "anyway) — the view layer routes SPMD views to the "
+            "un-donated per-tick path"
         )
 
     def _make_compact_jit(self, max_level: int = 10**9):

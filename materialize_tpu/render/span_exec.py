@@ -155,6 +155,10 @@ class SpanExecutor:
                 "readback_wait_ms": None,
                 "readbacks": None,
                 "overflow": False,
+                # The EFFECTIVE per-span donation fact (narrowed to
+                # supporting backends): bench --trace reports it per
+                # span so an A/B trace can prove which mode ran.
+                "donated": self.donate,
             }
             self.spans_submitted += 1
             prev, self._inflight = self._inflight, (snap, rec, deltas)

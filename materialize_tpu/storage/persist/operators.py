@@ -126,7 +126,16 @@ def _hist_host(entry):
     converted lazily on the rare rewind read; pre-existing host-tuple
     entries (SPMD gathers) pass through. Prefer :func:`_hist_host_at`
     when iterating a history list — it memoizes the conversion."""
-    return entry if isinstance(entry, tuple) else _host_updates(entry)
+    if isinstance(entry, tuple):
+        return entry
+    from ...analysis.donation import guard_read
+
+    # The rewind read is a d2h conversion: under the buffer sanitizer
+    # it must prove the retained delta was never donated (history
+    # entries are span OUTPUTS, never the carry — an aliased entry
+    # here means someone resurrected a donated leaf into history).
+    guard_read(entry, "multiversion-history")
+    return _host_updates(entry)
 
 
 def _hist_host_at(history: list, i: int):
@@ -224,8 +233,25 @@ class IndexSource:
         # pipelined publisher may hold an in-flight span whose carry
         # is not yet validated (ISSUE 7 sequencing rule).
         publisher.sync_spans()
+        self.base_cloned = False
         if self._device:
-            self.base_batch = publisher.df.output_batch()
+            base = publisher.df.output_batch()
+            if publisher.donation_requested():
+                # Snapshot-at-subscribe (ISSUE 8): the publisher's
+                # output spine rides its DONATED span carry — sharing
+                # its buffers would hand this subscriber a reference
+                # the next donated span kills (the exact aliasing that
+                # blocked ROADMAP 4b). Copy-on-share at the subscriber
+                # boundary: one state-sized clone HERE, paid only by
+                # dataflows that are actually subscribed to AND only
+                # when donation is requested — unsubscribed views pay
+                # nothing, and the publisher's donation verdict stays
+                # provably safe.
+                from ...arrangement.spine import clone_state_tree
+
+                base = clone_state_tree(base)
+                self.base_cloned = True
+            self.base_batch = base
         else:
             self.host_transfers += 1
             self.base = _host_updates(publisher.result_batch())
@@ -284,6 +310,19 @@ class IndexSource:
             schema=b.schema,
         )
 
+    def _guard(self, tree) -> None:
+        """Use-after-donate guard on every device read this subscriber
+        performs: the base snapshot and pending deltas must never be
+        buffers a publisher's donated span killed (buffer_sanitizer;
+        no-op when off)."""
+        from ...analysis.donation import guard_read
+
+        guard_read(
+            tree,
+            f"IndexSource(subscriber of "
+            f"{getattr(self.publisher.df, 'name', 'df')!r})",
+        )
+
     def snapshot(self, as_of: int) -> "tuple[Batch, int]":
         if as_of < self.base_upper - 1:
             # Multiversion rewind: the publisher retains a bounded
@@ -299,6 +338,8 @@ class IndexSource:
                     f"[{pub.since}, {pub.upper})"
                 )
             self.frontier = as_of + 1
+            if self._device:
+                self._guard(self.base_batch)
             parts = [
                 _host_updates(self.base_batch)
                 if self._device
@@ -338,6 +379,7 @@ class IndexSource:
             from ...ops.sort import concat_batches
 
             parts = [self.base_batch] + self._take_until(as_of + 1)
+            self._guard(parts)
             b = concat_batches(parts) if len(parts) > 1 else parts[0]
             return (
                 self._forward_times(b, as_of).replace(schema=self.schema),
@@ -364,6 +406,7 @@ class IndexSource:
 
             if not parts:
                 return Batch.empty(self.schema, 256)
+            self._guard(parts)
             b = concat_batches(parts) if len(parts) > 1 else parts[0]
             return self._forward_times(b, target - 1).replace(
                 schema=self.schema
@@ -493,12 +536,27 @@ class MaintainedView:
         # read sequences through sync_spans() automatically.
         self._barrier = _ViewSpanBarrier(self)
         dataflow._span_exec = self._barrier
+        # Donation state (ISSUE 8): the buffer-provenance prover's
+        # verdict gates whether this view's run_steps span train
+        # donates its carry. Recomputed when the sharing structure
+        # (subscriber set / donation request) changes, and only at
+        # defer-window boundaries — a window keeps its decision.
+        self._donation_sig = None
+        self._donation_verdict = None
+        self._donation_info: dict | None = None
+        self._donation_dirty = False
+        self.donated_parts: tuple = ()
         try:
             self.hydrate()
         except BaseException:
             self.expire()  # release reader holds of a failed build
             raise
         self._dispatched = self._upper
+        # Decide donation NOW so every installed dataflow has a
+        # provenance/donation verdict from its very first frontier
+        # report (EXPLAIN ANALYSIS / mz_donation must never be blind
+        # on an idle dataflow).
+        self._span_donation()
 
     @property
     def upper(self) -> int:
@@ -903,6 +961,57 @@ class MaintainedView:
     # subscriber snapshots sequence against COMMITTED span boundaries
     # via sync_spans() — they can never observe a half-applied carry.
 
+    # -- donation decision (ISSUE 8: the prover-gated span train) ----------
+
+    def donation_requested(self) -> bool:
+        """Whether donation POLICY asks for a donated carry on this
+        view's span train: the ``span_donation`` dyncfg resolved
+        through the one shared backend predicate
+        (render/dataflow._donation_supported via
+        span_exec.resolve_donation), restricted to single-device
+        dataflows (SPMD carries cannot alias through shard_map
+        boundary specs). The provenance PROVER decides whether the
+        request is safe — see :meth:`_span_donation`."""
+        from ...render.dataflow import Dataflow as _SingleDevice
+        from ...render.span_exec import resolve_donation
+
+        return type(self.df) is _SingleDevice and resolve_donation(None)
+
+    def _span_donation(self) -> tuple:
+        """The carry parts this view's next span train donates: the
+        buffer-provenance prover's per-argnum verdict, recomputed only
+        when the sharing signature (donation request, subscriber set)
+        changes, and frozen for the duration of a defer window (a
+        window that started un-donated must not start donating
+        mid-window — run_steps enforces the same rule)."""
+        if getattr(self.df, "_defer_ck", None) is not None:
+            return self.donated_parts
+        requested = self.donation_requested()
+        sig = (requested, tuple(id(s) for s in self._subscribers))
+        if sig != self._donation_sig:
+            from ...analysis.donation import view_verdict
+            from ...render.dataflow import _donation_supported
+
+            name = getattr(self.df, "name", "df")
+            v = view_verdict(name, self, requested=requested)
+            self._donation_sig = sig
+            self._donation_verdict = v
+            self.donated_parts = v.donate_parts() if requested else ()
+            info = v.to_dict()
+            info["donated"] = list(self.donated_parts)
+            info["wired"] = bool(
+                self.donated_parts and _donation_supported()
+            )
+            self._donation_info = info
+            self._donation_dirty = True
+        return self.donated_parts
+
+    def donation_info(self) -> dict | None:
+        """The last provenance/donation verdict (replica frontier
+        reports carry it to the controller for EXPLAIN ANALYSIS and
+        the mz_donation introspection relation)."""
+        return self._donation_info
+
     def step_span(
         self, max_ticks: int | None = None, timeout: float = 0.0
     ) -> bool:
@@ -970,7 +1079,9 @@ class MaintainedView:
         if self.df.time != ticks[0][0]:
             self.df.time = ticks[0][0]
         deltas = self.df.run_steps(
-            [inp for _, inp in ticks], defer_check=True
+            [inp for _, inp in ticks],
+            defer_check=True,
+            donate=self._span_donation(),
         )
         if self.df.check_flags():
             deltas = self.df.replayed_deltas
@@ -1022,7 +1133,9 @@ class MaintainedView:
         self._barrier.in_dispatch = True
         try:
             deltas = self.df.run_steps(
-                [inp for _, inp in ticks], defer_check=True
+                [inp for _, inp in ticks],
+                defer_check=True,
+                donate=self._span_donation(),
             )
         finally:
             self._barrier.in_dispatch = False

@@ -35,9 +35,11 @@ class Config:
 
 class ConfigSet:
     def __init__(self):
+        from .lockcheck import tracked_lock
+
         self._configs: dict[str, Config] = {}
         self._values: dict[str, Any] = {}
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("dyncfg")
 
     def add(self, cfg: Config) -> None:
         with self._lock:
@@ -242,6 +244,23 @@ SPAN_DONATION = Config(
     "The rollback checkpoint is CLONED to fresh buffers before the "
     "first donated dispatch of a window — donated buffers are never "
     "read back",
+).register(COMPUTE_CONFIGS)
+
+# -- buffer-provenance / donation safety (ISSUE 8) ---------------------------
+
+BUFFER_SANITIZER = Config(
+    "buffer_sanitizer", False,
+    "use-after-donate sanitizer: every donated span/step dispatch "
+    "records the killed carry leaves in a ledger (weakrefs — never "
+    "extends a buffer's lifetime), and guarded read sites "
+    "(IndexSource snapshots, multiversion rewinds, operand packing) "
+    "raise UseAfterDonateError with the provenance chain naming who "
+    "still held the alias. The donation CONTRACT is backend-"
+    "independent, so the sanitizer enforces it on CPU too — the test "
+    "suite (default ON under `pytest -m analysis`) catches "
+    "use-after-donate bugs on hosts where real donation is not even "
+    "wired. Production default off (one ledger walk per donated "
+    "dispatch)",
 ).register(COMPUTE_CONFIGS)
 
 TRANSIENT_PEEK_CACHE = Config(

@@ -15,9 +15,11 @@ from bisect import bisect_right
 
 class _Metric:
     def __init__(self, name: str, help_: str, registry: "MetricsRegistry"):
+        from .lockcheck import tracked_lock
+
         self.name = name
         self.help = help_
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("metrics.metric")
         if registry is not None:
             registry._register(self)
 
@@ -120,8 +122,10 @@ class MetricsRegistry:
     """Register-and-scrape: the per-process metrics authority."""
 
     def __init__(self):
+        from .lockcheck import tracked_lock
+
         self._metrics: dict[str, _Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("metrics.registry")
 
     def _register(self, m: _Metric) -> None:
         with self._lock:

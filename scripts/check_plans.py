@@ -295,7 +295,124 @@ def run_bench_mode(verbose: bool) -> int:
 
     hs = lint_hot_path()
     gate("host-sync-hot-path", None, hs, 0)
+    rc |= run_donation_gates(gate)
+    rc |= run_lockcheck_smoke(gate)
     return rc
+
+
+def run_donation_gates(gate) -> int:
+    """Buffer-provenance / donation-safety gates (ISSUE 8):
+
+    - every standard bench dataflow, freshly rendered (no
+      subscribers), must PROVE fully donatable — zero
+      unsound-donation findings is the acceptance gate for the
+      replica's donated run_steps span train;
+    - the donated step program's lowering must carry
+      input_output_aliases on carry parameters only (a signature
+      refactor that drifts donate_argnums off the carry fails here,
+      statically);
+    - the donated-leaf-reuse AST rule: no registered dispatch
+      function reads a carry attribute between a dispatch and its
+      re-assignment."""
+    from materialize_tpu.analysis import (
+        UNSOUND_DONATION,
+        LintFinding,
+        dataflow_verdict,
+        donation_lowering_findings,
+        lint_donated_reuse,
+    )
+
+    rc = 0
+    for name, mk in bench_dataflows().items():
+        df = mk()
+        v = dataflow_verdict(name, df, requested=True)
+        vf = list(v.findings)
+        if not v.safe:
+            vf.append(
+                LintFinding(
+                    UNSOUND_DONATION,
+                    name,
+                    "freshly rendered dataflow is not provably "
+                    "donatable: " + "; ".join(v.reasons),
+                )
+            )
+        gate(f"{name}-donation", None, vf, 0)
+        rc |= 1 if vf else 0
+    low = donation_lowering_findings()
+    gate("donation-lowering", None, low, 0)
+    dr = lint_donated_reuse()
+    gate("donated-reuse", None, dr, 0)
+    return 1 if (low or dr) else 0
+
+
+def run_lockcheck_smoke(gate) -> int:
+    """Lock-order sanitizer smoke (ISSUE 8 satellite): drive the
+    ordinary coordinator/replica serving path — DDL, ingest, fast- and
+    slow-path peeks, introspection — with utils/lockcheck recording
+    every lock acquisition, and gate on zero findings (no order
+    cycles, no device dispatch under the sequencing lock)."""
+    import socket
+    import tempfile
+    import threading
+    import time as _t
+
+    from materialize_tpu.analysis import LintFinding
+    from materialize_tpu.coord.coordinator import Coordinator
+    from materialize_tpu.coord.protocol import PersistLocation
+    from materialize_tpu.coord.replica import serve_forever
+    from materialize_tpu.storage.persist import (
+        FileBlob,
+        PersistClient,
+        SqliteConsensus,
+    )
+    from materialize_tpu.utils import lockcheck
+
+    lockcheck.enable()
+    coord = None
+    try:
+        tmp = tempfile.mkdtemp(prefix="lockcheck-smoke-")
+        loc = PersistLocation(
+            os.path.join(tmp, "blob"), os.path.join(tmp, "c.db")
+        )
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        ready = threading.Event()
+        threading.Thread(
+            target=serve_forever,
+            args=(port, loc, "r0", ready),
+            daemon=True,
+        ).start()
+        ready.wait(10)
+        coord = Coordinator(
+            PersistClient(
+                FileBlob(loc.blob_root),
+                SqliteConsensus(loc.consensus_path),
+            ),
+            tick_interval=None,
+        )
+        coord.add_replica("r0", ("127.0.0.1", port))
+        coord.execute("CREATE TABLE t (a INT, b INT)")
+        coord.execute("INSERT INTO t VALUES (1, 2), (3, 4)")
+        coord.execute(
+            "CREATE MATERIALIZED VIEW mv AS SELECT a, b FROM t"
+        )
+        coord.execute("CREATE INDEX i ON mv (a)")
+        coord.execute("SELECT * FROM mv")
+        coord.execute("SELECT * FROM mv WHERE a = 1")
+        coord.execute("SELECT * FROM mz_donation")
+        _t.sleep(0.2)  # let the replica loop run a few parked passes
+    finally:
+        if coord is not None:
+            coord.shutdown()
+        lockcheck.disable()
+    findings = [
+        LintFinding("lockcheck", f.kind, f.message)
+        for f in lockcheck.findings()
+    ]
+    gate("lockcheck-smoke", None, findings, 0)
+    return 1 if findings else 0
 
 
 def main(argv=None) -> int:

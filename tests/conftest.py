@@ -69,11 +69,24 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "analysis: static-analysis lane (typechecker, monotonicity, "
-        "jaxpr linter) — run fast with `pytest -m analysis`",
+        "jaxpr linter, donation prover/sanitizer) — run fast with "
+        "`pytest -m analysis`",
     )
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 lane (-m 'not slow')"
     )
+    # The use-after-donate sanitizer is DEFAULT ON in the analysis
+    # lane (ISSUE 8): donated dispatches record their killed carry
+    # leaves and every guarded read site validates against the ledger.
+    # The full suite keeps the production default (off) — individual
+    # donation tests flip it explicitly. Matches the `analysis` marker
+    # being SELECTED (compound expressions like
+    # `-m "analysis and not slow"` included), not an exact string.
+    import re
+
+    markexpr = (getattr(config.option, "markexpr", "") or "").strip()
+    if re.search(r"(?<!not )\banalysis\b", markexpr):
+        COMPUTE_CONFIGS.update({"buffer_sanitizer": True})
 
 
 # -- replica-worker leak control ---------------------------------------------

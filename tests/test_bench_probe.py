@@ -1,0 +1,93 @@
+"""Bench-tier probe budget satellite (ISSUE 8): a tier probe that
+blows the per-probe budget records ``probe_timeout`` in
+bench_tiers.json and later sweeps skip the config in seconds instead
+of re-burning the 900s cap per run (the q9/pagerank rollover,
+ROADMAP items 1/4c)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def bench(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_under_test"] = mod
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(
+        mod, "TIERS_PATH", str(tmp_path / "bench_tiers.json")
+    )
+    yield mod
+    sys.modules.pop("bench_under_test", None)
+
+
+def test_reprobe_records_probe_timeout_marker(bench, monkeypatch):
+    calls = []
+
+    def fake_probe(name, timeout=None):
+        calls.append(name)
+        return None, "timeout after 900s"
+
+    monkeypatch.setattr(bench, "_probe_config", fake_probe)
+    bench.reprobe(["q9"])
+    with open(bench.TIERS_PATH) as f:
+        tiers = json.load(f)
+    assert calls == ["q9"]
+    marker = tiers["q9"]
+    assert marker["probe_timeout"] == bench.CONFIG_TIMEOUT_S
+    assert "timeout" in marker["error"]
+
+
+def test_reprobe_keeps_nontimeout_failures_unrecorded(
+    bench, monkeypatch
+):
+    monkeypatch.setattr(
+        bench,
+        "_probe_config",
+        lambda name, timeout=None: (None, "rc=1"),
+    )
+    bench.reprobe(["q9"])
+    assert not os.path.exists(bench.TIERS_PATH) or "q9" not in (
+        json.load(open(bench.TIERS_PATH))
+    )
+
+
+def test_explicit_reprobe_retries_and_clears_marker(bench, monkeypatch):
+    with open(bench.TIERS_PATH, "w") as f:
+        json.dump(
+            {"q9": bench._probe_timeout_marker("timeout after 900s", 900)},
+            f,
+        )
+    good = {"grow": [], "join_caps": [], "letrec_caps": [],
+            "out_delta_cap": 4096, "slot_cap": 256}
+    monkeypatch.setattr(
+        bench, "_probe_config", lambda name, timeout=None: (good, None)
+    )
+    bench.reprobe(["q9"])
+    with open(bench.TIERS_PATH) as f:
+        tiers = json.load(f)
+    assert tiers["q9"] == good  # a successful probe replaces the marker
+
+
+def test_measure_refuses_probe_timeout_marker(bench, monkeypatch):
+    with open(bench.TIERS_PATH, "w") as f:
+        json.dump(
+            {"q9": bench._probe_timeout_marker("timeout after 900s", 900)},
+            f,
+        )
+    monkeypatch.setattr(
+        sys, "argv", ["bench.py", "--measure", "q9"]
+    )
+    with pytest.raises(SystemExit) as ei:
+        bench.main()
+    assert "probe_timeout" in str(ei.value)

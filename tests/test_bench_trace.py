@@ -46,6 +46,9 @@ SPAN_KEYS = {
     "readback_wait_ms",
     "readbacks",
     "overflow",
+    # ISSUE 8: the EFFECTIVE per-span donation fact (narrowed to
+    # supporting backends) so an A/B trace proves which mode ran.
+    "donated",
 }
 GAP_KEYS = {"host_ms", "device_wait_ms", "wall_ms", "overlapped_ms"}
 

@@ -1,0 +1,498 @@
+"""Buffer-provenance / donation-safety tests (ISSUE 8).
+
+The prover must rule a fresh render fully donatable and refute
+donation when an IndexSource subscriber aliases the publisher's spine;
+the use-after-donate sanitizer must catch a deliberately resurrected
+donated leaf (which SILENTLY serves wrong-lifetime data without it);
+and the replica's donated ``run_steps`` span train must be
+row-for-row identical to the un-donated train under
+duplicate/retraction churn with a live subscriber."""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.analysis import (
+    LEDGER,
+    DonationVerdict,
+    UseAfterDonateError,
+    dataflow_verdict,
+    donation_lowering_findings,
+    lint_donated_reuse,
+    view_verdict,
+)
+from materialize_tpu.analysis.donation import (
+    lint_donated_reuse_function,
+)
+from materialize_tpu.analysis.provenance import (
+    CARRY_PARTS,
+    PROV_CARRY,
+    PROV_SHARED,
+    ProvenanceReport,
+    scan_view,
+)
+from materialize_tpu.expr import relation as mir
+from materialize_tpu.render.dataflow import Dataflow
+from materialize_tpu.repr.schema import Column, ColumnType, Schema
+from materialize_tpu.storage.persist import (
+    IndexSource,
+    MaintainedView,
+    MemBlob,
+    MemConsensus,
+    PersistClient,
+)
+from materialize_tpu.utils.dyncfg import COMPUTE_CONFIGS
+
+from .oracle import as_multiset
+
+pytestmark = pytest.mark.analysis
+
+KV = Schema([Column("k", ColumnType.INT64), Column("v", ColumnType.INT64)])
+
+
+def _updates(pairs, t=0):
+    k = np.array([p[0] for p in pairs], np.int64)
+    v = np.array([p[1] for p in pairs], np.int64)
+    d = np.array([p[2] for p in pairs], np.int64)
+    return [k, v], [None, None], np.full(len(pairs), t, np.uint64), d
+
+
+@pytest.fixture
+def dyncfg():
+    """Flip donation/sanitizer flags for one test, then restore the
+    PRIOR values (not the registered defaults — the analysis lane's
+    conftest installs buffer_sanitizer=True lane-wide, and a reset to
+    default would silently disarm it for every later test)."""
+    before = COMPUTE_CONFIGS.current()
+    keys = ("span_donation", "buffer_sanitizer")
+
+    def set_(**kv):
+        COMPUTE_CONFIGS.update(kv)
+
+    yield set_
+    COMPUTE_CONFIGS.update({k: before[k] for k in keys})
+    LEDGER.clear()
+
+
+def _drain(view, upto, spans=64):
+    """Drive a view's SPAN train (step_span — the replica's pipelined
+    path) until its committed frontier reaches ``upto``."""
+    for _ in range(spans):
+        if view.upper >= upto:
+            break
+        view.step_span(timeout=1.0)
+    view.sync_spans()
+    assert view.upper >= upto, (view.upper, upto)
+
+
+# ---------------------------------------------------------------------------
+# the prover
+# ---------------------------------------------------------------------------
+
+
+class TestProver:
+    def test_fresh_render_is_fully_donatable(self):
+        df = Dataflow(mir.Get("src", KV), name="fresh")
+        v = dataflow_verdict("fresh", df, requested=True)
+        assert isinstance(v, DonationVerdict)
+        assert v.safe and v.donate_parts() == tuple(CARRY_PARTS)
+        assert v.provenance.get(PROV_CARRY, 0) > 0
+        assert v.findings == []
+
+    def test_subscriber_alias_refutes_output_donation(self, dyncfg):
+        """An IndexSource subscribed WITHOUT snapshot-at-subscribe
+        (donation off at subscribe time) holds live references into
+        the publisher's output spine: the prover must refute donating
+        the output argnum and name the alias holder."""
+        dyncfg(span_donation="off")
+        c = PersistClient(MemBlob(), MemConsensus())
+        w = c.open_writer("kv", KV)
+        w.compare_and_append(*_updates([(1, 10, 1)], t=0), 0, 1)
+        pub = MaintainedView(
+            c, Dataflow(mir.Get("kv", KV), name="pub"),
+            {"kv": ("kv", KV)}, None,
+        )
+        _drain(pub, 1)
+        isrc = IndexSource(pub, KV)
+        assert not isrc.base_cloned  # donation off -> no copy-on-share
+        v = view_verdict("pub", pub, requested=True)
+        assert not v.donatable["output"]
+        assert any("subscriber" in r for r in v.reasons)
+        # The sharing graph names the consumer.
+        report = ProvenanceReport()
+        scan_view(report, "pub", pub)
+        assert any(
+            PROV_SHARED in rec.classes
+            for rec in report.leaves.values()
+        )
+        isrc.reader.expire()
+
+    def test_snapshot_at_subscribe_restores_safety(self, dyncfg):
+        """With donation requested, subscribing clones the base
+        snapshot (copy-on-share) — the publisher's verdict stays fully
+        donatable, and the subscriber still reads identical rows."""
+        dyncfg(span_donation="on")
+        c = PersistClient(MemBlob(), MemConsensus())
+        w = c.open_writer("kv", KV)
+        w.compare_and_append(
+            *_updates([(1, 10, 1), (2, 20, 1)], t=0), 0, 1
+        )
+        w.compare_and_append(*_updates([(3, 30, 1)], t=1), 1, 2)
+        pub = MaintainedView(
+            c, Dataflow(mir.Get("kv", KV), name="pub"),
+            {"kv": ("kv", KV)}, None,
+        )
+        _drain(pub, 2)
+        isrc = IndexSource(pub, KV)
+        assert isrc.base_cloned
+        v = view_verdict("pub", pub, requested=True)
+        assert v.safe, v.reasons
+        sub = MaintainedView(
+            c, Dataflow(mir.Get("pub", KV), name="sub"), {}, None,
+            index_sources={"pub": isrc},
+        )
+        _drain(sub, pub.upper)
+        assert as_multiset(sub.peek()) == as_multiset(pub.peek())
+
+    def test_verdict_gates_replica_train(self, dyncfg):
+        """donated_parts on the view follows request x verdict: off ->
+        empty; on + no subscribers -> the full carry."""
+        dyncfg(span_donation="off")
+        c = PersistClient(MemBlob(), MemConsensus())
+        w = c.open_writer("kv", KV)
+        w.compare_and_append(*_updates([(1, 1, 1)], t=0), 0, 1)
+        view = MaintainedView(
+            c, Dataflow(mir.Get("kv", KV), name="v"),
+            {"kv": ("kv", KV)}, None,
+        )
+        assert view.donated_parts == ()
+        info = view.donation_info()
+        assert info is not None and not info["requested"]
+        dyncfg(span_donation="on")
+        # Fresh window + changed request -> re-decide.
+        view._donation_sig = None
+        assert view._span_donation() == tuple(CARRY_PARTS)
+        info = view.donation_info()
+        assert info["requested"] and info["safe"]
+        assert tuple(info["donated"]) == tuple(CARRY_PARTS)
+
+
+# ---------------------------------------------------------------------------
+# the sanitizer
+# ---------------------------------------------------------------------------
+
+
+def _donated_view_with_resurrected_leaf(c, sanitizer: bool):
+    """Build an index view, run DONATED spans, then deliberately
+    resurrect a pre-span (donated) carry leaf into the multiversion
+    history — the exact alias class the prover calls host-retained."""
+    COMPUTE_CONFIGS.update(
+        {"span_donation": "on", "buffer_sanitizer": sanitizer}
+    )
+    w = c.open_writer("kv", KV)
+    w.compare_and_append(*_updates([(1, 10, 1), (2, 20, 1)], t=0), 0, 1)
+    view = MaintainedView(
+        c, Dataflow(mir.Get("kv", KV), name="uad"),
+        {"kv": ("kv", KV)}, None,
+    )
+    _drain(view, 1)
+    assert view.donated_parts == tuple(CARRY_PARTS)
+    # The carry ABOUT to be killed by the next donated span.
+    pre_spine_base = view.df.output.base
+    for t in range(1, 3):
+        w.compare_and_append(
+            *_updates([(1, 10, -1), (3, 30 + t, 1)], t=t), t, t + 1
+        )
+        _drain(view, t + 1)
+    # Resurrect: swap the latest retained delta for the dead batch.
+    ht, _old = view._history[-1]
+    view._history[-1] = (ht, pre_spine_base)
+    return view
+
+
+class TestUseAfterDonateSanitizer:
+    def test_without_sanitizer_the_resurrection_is_silent(self, dyncfg):
+        """The seeded fixture FAILS (goes undetected) without the
+        sanitizer: on backends that ignore donate_argnums the dead
+        buffer still holds bytes, so the rewind silently serves rows
+        from a wrong-lifetime buffer. This test documents the miss the
+        sanitizer exists to close."""
+        c = PersistClient(MemBlob(), MemConsensus())
+        view = _donated_view_with_resurrected_leaf(c, sanitizer=False)
+        # No error: the use-after-donate sails through undetected.
+        view.updates_as_of(view.since)
+
+    def test_sanitizer_catches_resurrected_leaf(self, dyncfg):
+        c = PersistClient(MemBlob(), MemConsensus())
+        view = _donated_view_with_resurrected_leaf(c, sanitizer=True)
+        with pytest.raises(UseAfterDonateError) as ei:
+            view.updates_as_of(view.since)
+        msg = str(ei.value)
+        # The error names the reader AND the dispatch that killed the
+        # buffer (the provenance chain).
+        assert "multiversion-history" in msg
+        assert "run_steps step" in msg and "donated" in msg
+
+    def test_subscriber_read_of_donated_base_is_caught(self, dyncfg):
+        """A subscriber that somehow kept an un-cloned base while the
+        publisher donates (the exact ROADMAP 4b hazard, forced here by
+        hand) is caught at its own read site."""
+        dyncfg(span_donation="off", buffer_sanitizer=True)
+        c = PersistClient(MemBlob(), MemConsensus())
+        w = c.open_writer("kv", KV)
+        w.compare_and_append(*_updates([(1, 10, 1)], t=0), 0, 1)
+        pub = MaintainedView(
+            c, Dataflow(mir.Get("kv", KV), name="pub"),
+            {"kv": ("kv", KV)}, None,
+        )
+        _drain(pub, 1)
+        isrc = IndexSource(pub, KV)  # donation off: NOT cloned
+        assert not isrc.base_cloned
+        # Flip donation on and FORCE the unsafe decision, bypassing
+        # the prover (which would refuse): the sanitizer is the last
+        # line of defense.
+        dyncfg(span_donation="on", buffer_sanitizer=True)
+        pub._donation_sig = None
+        pub._donation_verdict = None
+        pub.donated_parts = tuple(CARRY_PARTS)
+        pub._donation_sig = (True, tuple(id(s) for s in pub._subscribers))
+        w.compare_and_append(*_updates([(2, 20, 1)], t=1), 1, 2)
+        _drain(pub, 2)
+        with pytest.raises(UseAfterDonateError) as ei:
+            isrc.snapshot(pub.upper - 1)
+        assert "IndexSource" in str(ei.value)
+        isrc.reader.expire()
+
+    def test_ledger_identity_is_weakref_validated(self, dyncfg):
+        dyncfg(buffer_sanitizer=True)
+        import jax.numpy as jnp
+
+        a = jnp.arange(4)
+        LEDGER.record((a,), "test-dispatch")
+        with pytest.raises(UseAfterDonateError):
+            LEDGER.check((a,), "reader")
+        aid = id(a)
+        del a
+        # A NEW array reusing the id must not false-positive.
+        import gc
+
+        gc.collect()
+        b = jnp.arange(8)
+        LEDGER.check((b,), "reader")  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# donated == undonated equivalence (SUBSCRIBE-alive property test)
+# ---------------------------------------------------------------------------
+
+
+def _churn_rows(rng, live: dict, n: int):
+    """Duplicate/retraction churn: inserts (with duplicates) and
+    retractions of currently-live rows."""
+    rows = []
+    for _ in range(n):
+        if live and rng.random() < 0.4:
+            k, v = list(live)[int(rng.integers(len(live)))]
+            rows.append((k, v, -1))
+            live[(k, v)] -= 1
+            if live[(k, v)] == 0:
+                del live[(k, v)]
+        else:
+            k = int(rng.integers(0, 12))
+            v = int(rng.integers(0, 4))
+            rows.append((k, v, 1))
+            live[(k, v)] = live.get((k, v), 0) + 1
+    return rows
+
+
+def _run_subscribe_churn(mode: str):
+    COMPUTE_CONFIGS.update(
+        {"span_donation": mode, "buffer_sanitizer": True}
+    )
+    rng = np.random.default_rng(1234)
+    c = PersistClient(MemBlob(), MemConsensus())
+    w = c.open_writer("kv", KV)
+    w.compare_and_append(
+        *_updates([(1, 1, 1), (2, 2, 1), (1, 1, 1)], t=0), 0, 1
+    )
+    w.compare_and_append(*_updates([(5, 1, 1)], t=1), 1, 2)
+    pub = MaintainedView(
+        c, Dataflow(mir.Get("kv", KV), name="pub"),
+        {"kv": ("kv", KV)}, None,
+    )
+    _drain(pub, 2)
+    isrc = IndexSource(pub, KV)
+    assert isrc.base_cloned == (mode == "on")
+    sub = MaintainedView(
+        c, Dataflow(mir.Get("pub", KV), name="sub"), {}, None,
+        index_sources={"pub": isrc},
+    )
+    live: dict = {(1, 1): 2, (2, 2): 1, (5, 1): 1}
+    last = 14
+    for t in range(2, last):
+        rows = _churn_rows(rng, live, 6)
+        w.compare_and_append(*_updates(rows, t=t), t, t + 1)
+        if t % 4 == 0:  # backlogs make multi-tick spans
+            _drain(pub, t + 1)
+            _drain(sub, t + 1)
+    _drain(pub, last)
+    _drain(sub, last)
+    pub_rows = as_multiset(pub.peek())
+    sub_rows = as_multiset(sub.peek())
+    donated = pub.donated_parts
+    return pub_rows, sub_rows, donated
+
+
+class TestDonatedEquivalence:
+    def test_donated_equals_undonated_with_live_subscriber(self, dyncfg):
+        """The acceptance property: donated run_steps == undonated,
+        row for row, under duplicate/retraction churn, with a
+        SUBSCRIBE-alive IndexSource importing the publisher the whole
+        time (snapshot-at-subscribe resolving the alias)."""
+        pub_on, sub_on, donated_on = _run_subscribe_churn("on")
+        pub_off, sub_off, donated_off = _run_subscribe_churn("off")
+        assert donated_on == tuple(CARRY_PARTS)
+        assert donated_off == ()
+        assert pub_on == pub_off
+        assert sub_on == sub_off
+        assert sub_on == pub_on  # the import mirrors the index
+
+
+# ---------------------------------------------------------------------------
+# the coordinator surface: EXPLAIN ANALYSIS + mz_donation
+# ---------------------------------------------------------------------------
+
+
+class TestCoordinatorSurface:
+    def test_explain_analysis_and_mz_donation_cover_installs(
+        self, tmp_path
+    ):
+        """Acceptance: EXPLAIN ANALYSIS shows a provenance/donation
+        verdict for EVERY installed dataflow, and mz_donation serves
+        the same verdicts relationally."""
+        import socket
+        import threading
+        import time
+
+        from materialize_tpu.coord.coordinator import Coordinator
+        from materialize_tpu.coord.protocol import PersistLocation
+        from materialize_tpu.coord.replica import serve_forever
+        from materialize_tpu.storage.persist import (
+            FileBlob,
+            PersistClient,
+            SqliteConsensus,
+        )
+
+        loc = PersistLocation(
+            str(tmp_path / "blob"), str(tmp_path / "c.db")
+        )
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        ready = threading.Event()
+        threading.Thread(
+            target=serve_forever,
+            args=(port, loc, "r0", ready),
+            daemon=True,
+        ).start()
+        assert ready.wait(10)
+        coord = Coordinator(
+            PersistClient(
+                FileBlob(loc.blob_root),
+                SqliteConsensus(loc.consensus_path),
+            ),
+            tick_interval=None,
+        )
+        try:
+            coord.add_replica("r0", ("127.0.0.1", port))
+            coord.execute("CREATE TABLE t (a INT, b INT)")
+            coord.execute("INSERT INTO t VALUES (1, 2), (3, 4)")
+            coord.execute(
+                "CREATE MATERIALIZED VIEW mv AS SELECT a, b FROM t"
+            )
+            coord.execute(
+                "CREATE MATERIALIZED VIEW mv2 AS "
+                "SELECT a + 1 AS a1 FROM t"
+            )
+            coord.execute("SELECT * FROM mv")
+            with coord.controller._lock:
+                installed = sorted(coord.controller._dataflows)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                with coord.controller._lock:
+                    got = set(coord.controller.donation_verdicts)
+                if set(installed) <= got:
+                    break
+                time.sleep(0.05)
+            res = coord.execute("EXPLAIN ANALYSIS SELECT * FROM mv")
+            text = res.text
+            assert "donation:" in text
+            for name in installed:
+                assert f"{name}@r0:" in text, (name, text)
+                assert "pending" not in text
+            assert "provenance(" in text
+            assert "span-carry-owned" in text
+            rows = coord.execute("SELECT * FROM mz_donation").rows
+            assert {r[0] for r in rows} == set(installed)
+            for r in rows:
+                assert r[2] == 1  # safe: no sharing in this catalog
+        finally:
+            coord.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# static cross-checks
+# ---------------------------------------------------------------------------
+
+
+class TestStaticCrossChecks:
+    def test_lowering_aliases_carry_only(self):
+        assert donation_lowering_findings() == []
+
+    def test_registered_dispatchers_lint_clean(self):
+        findings = lint_donated_reuse()
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_reuse_lint_fires_on_seeded_fixture(self, tmp_path):
+        """The rule actually bites: a dispatcher that reads
+        self.output after the dispatch (e.g. to snapshot it) before
+        re-assigning is flagged; the sanctioned pragma silences it."""
+        import importlib.util
+        import textwrap
+
+        p = tmp_path / "donated_fixture.py"
+        p.write_text(
+            textwrap.dedent(
+                """
+                def bad(self, jitfn, args):
+                    carry = jitfn(*args)
+                    snap = self.output  # the dead buffer!
+                    self.output = carry[1]
+                    return snap
+
+                def sanctioned(self, jitfn, args):
+                    carry = jitfn(*args)
+                    snap = self.output  # donated: ok(test boundary)
+                    self.output = carry[1]
+                    return snap
+
+                def ok(self, jitfn, args):
+                    carry = jitfn(*args)
+                    self.output = carry[1]
+                    return self.output
+                """
+            )
+        )
+        spec = importlib.util.spec_from_file_location(
+            "donated_fixture", p
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        bad_findings = lint_donated_reuse_function(mod.bad, "bad")
+        assert len(bad_findings) == 1
+        assert "self.output" in bad_findings[0].message
+        assert (
+            lint_donated_reuse_function(mod.sanctioned, "sanctioned")
+            == []
+        )
+        assert lint_donated_reuse_function(mod.ok, "ok") == []
