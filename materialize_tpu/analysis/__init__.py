@@ -31,6 +31,12 @@ Three passes:
   sanitizer (dyncfg ``buffer_sanitizer``), and the static
   cross-checks (lowered input_output_aliases, donated-leaf-reuse AST
   rule).
+- ``shard_prop``: a shard-spec abstract interpreter over rendered
+  step-program jaxprs (PartitionSpec-style lattice: replicated ⊑
+  shard-local ⊑ cross-worker) emitting a collective-communication
+  census (the comm analog of ``op_census``) and the SPMD-safety
+  verdict gating per-device slot-ring ingest under ``shard_map``
+  (ISSUE 9).
 
 See doc/analysis.md for the catalogue of invariants and lints.
 """
@@ -73,6 +79,22 @@ from .host_sync import (  # noqa: F401
     host_sync_findings_dataflow,
     lint_function,
     lint_hot_path,
+)
+from .shard_prop import (  # noqa: F401
+    CROSS_WORKER,
+    REPLICATED,
+    SHARD_LOCAL,
+    CollectiveSite,
+    CommCensus,
+    ShardSafetyVerdict,
+    comm_census,
+    dataflow_sharding_report,
+    shard_map_analyses,
+    sharded_step_report,
+    sharding_display,
+    single_device_report,
+    spmd_safety,
+    trace_sharded_step,
 )
 from .monotonic import (  # noqa: F401
     BOTTOM,

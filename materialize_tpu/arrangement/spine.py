@@ -378,6 +378,16 @@ class Spine:
             new_lanes, self.slot_lanes,
         )
 
+    def with_cursor(self, cursor) -> "Spine":
+        """Replace the slot cursor (shape management only — the SPMD
+        layout carries it as a per-device ``[P]`` vector at the
+        shard_map boundary and reshapes it to the per-worker scalar
+        inside the step body; see ShardedDataflow)."""
+        return Spine(
+            self.runs_b, self.key, self.order, self.slots, cursor,
+            self.lanes, self.slot_lanes,
+        )
+
     def runs(self) -> tuple:
         """Single-run Arrangement views for lookup/probe code (base
         first, then progressively smaller runs, then ingest slots),

@@ -461,6 +461,11 @@ class ComputeController:
         # safety each replica reports whenever it changes. Surfaced by
         # EXPLAIN ANALYSIS and the mz_donation introspection relation.
         self.donation_verdicts: dict[str, dict[str, dict]] = {}
+        # Shard-spec prover reports (ISSUE 9, df -> replica -> report
+        # dict): SPMD-safety verdict of the slot-ring cursors, resolved
+        # ingest mode, communication census. Surfaced by EXPLAIN
+        # ANALYSIS's `sharding:` block and the mz_sharding relation.
+        self.sharding_verdicts: dict[str, dict[str, dict]] = {}
         self.statuses: deque = deque(maxlen=1000)  # replica error reports
         # Install acks: df name -> replica -> error string | None (ok).
         self.install_acks: dict[str, dict] = {}
@@ -521,6 +526,8 @@ class ComputeController:
                 per_df.pop(name, None)
             for per_df in self.donation_verdicts.values():
                 per_df.pop(name, None)
+            for per_df in self.sharding_verdicts.values():
+                per_df.pop(name, None)
 
     def _history_snapshot(self):
         with self._lock:
@@ -579,6 +586,7 @@ class ComputeController:
             self.arrangement_records.pop(name, None)
             self.span_epochs.pop(name, None)
             self.donation_verdicts.pop(name, None)
+            self.sharding_verdicts.pop(name, None)
             self.install_acks.pop(name, None)
         self._broadcast(ctp.drop_dataflow(name))
 
@@ -672,6 +680,10 @@ class ComputeController:
                             ] = e
                         for df, v in msg.get("donation", {}).items():
                             self.donation_verdicts.setdefault(df, {})[
+                                replica
+                            ] = v
+                        for df, v in msg.get("sharding", {}).items():
+                            self.sharding_verdicts.setdefault(df, {})[
                                 replica
                             ] = v
             elif kind == "Status":

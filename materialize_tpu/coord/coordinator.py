@@ -224,6 +224,51 @@ class Coordinator:
                 )
         return "\n".join(lines)
 
+    def _sharding_analysis_text(self) -> str:
+        """Shard-spec prover reports for every installed catalog-named
+        dataflow (the EXPLAIN ANALYSIS `sharding:` block, ISSUE 9;
+        mz_sharding serves the same rows relationally). Same coverage
+        discipline as the donation block: a dataflow whose replica has
+        not reported yet prints as pending, never omitted."""
+        from ..analysis.shard_prop import sharding_display
+
+        named = {it.name for it in self.catalog.items.values()}
+        named |= set(self.peekable.values())
+        with self.controller._lock:
+            installed = sorted(
+                n for n in self.controller._dataflows if n in named
+            )
+            verdicts = {
+                df: dict(per)
+                for df, per in (
+                    self.controller.sharding_verdicts.items()
+                )
+            }
+        lines = ["sharding:"]
+        if not installed:
+            lines.append("  (no dataflows installed)")
+        for name in installed:
+            per = verdicts.get(name)
+            if not per:
+                lines.append(
+                    f"  {name}: pending (no replica report yet)"
+                )
+                continue
+            for rep, v in sorted(per.items()):
+                census, blame = sharding_display(v)
+                line = (
+                    f"  {name}@{rep}: "
+                    f"spmd={str(bool(v.get('spmd'))).lower()} "
+                    f"workers={int(v.get('workers') or 1)} "
+                    f"ingest={v.get('ingest_mode')} "
+                    f"safe={str(bool(v.get('safe'))).lower()} "
+                    f"comm({census})"
+                )
+                if blame:
+                    line += f" blame[{blame}]"
+                lines.append(line)
+        return "\n".join(lines)
+
     # -- durable catalog ----------------------------------------------------
     def _catalog_append(self, record: dict, diff: int) -> None:
         self._net_durable += 1 if diff > 0 else -1
@@ -386,11 +431,18 @@ class Coordinator:
         if isinstance(plan, ExplainPlan):
             text = plan.text
             if plan.stage == "analysis":
-                # The LIVE half of EXPLAIN ANALYSIS (ISSUE 8): the
-                # buffer-provenance / donation-safety verdict of every
-                # INSTALLED dataflow, as last reported by the replicas
-                # (the plan-side half above is static and catalog-only).
-                text = text + "\n" + self._donation_analysis_text()
+                # The LIVE half of EXPLAIN ANALYSIS (ISSUE 8 + 9): the
+                # buffer-provenance / donation-safety verdict and the
+                # shard-spec prover report of every INSTALLED dataflow,
+                # as last reported by the replicas (the plan-side half
+                # above is static and catalog-only).
+                text = (
+                    text
+                    + "\n"
+                    + self._donation_analysis_text()
+                    + "\n"
+                    + self._sharding_analysis_text()
+                )
             return ExecuteResult(
                 "text", text=text, columns=("explain",)
             )

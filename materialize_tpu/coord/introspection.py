@@ -59,6 +59,19 @@ INTROSPECTION_SCHEMAS: dict[str, Schema] = {
             Column("provenance", S),
         ]
     ),
+    "mz_sharding": Schema(
+        [
+            Column("dataflow", S),
+            Column("replica", S),
+            Column("spmd", I),
+            Column("workers", I),
+            Column("ingest_mode", S),
+            Column("safe", I),
+            Column("collectives", I),
+            Column("comm_bytes", I),
+            Column("blame", S),
+        ]
+    ),
     "mz_metrics": Schema(
         [Column("metric", S), Column("value", F)]
     ),
@@ -173,6 +186,41 @@ def snapshot(coord, name: str) -> list[tuple]:
                         int(bool(v.get("wired"))),
                         _enc(donated),
                         _enc(prov),
+                    )
+                )
+        return rows
+    if name == "mz_sharding":
+        # The shard-spec prover's reports (ISSUE 9): per (dataflow,
+        # replica), whether the dataflow runs SPMD, how many workers,
+        # the prover-gated ingest mode, the SPMD-safety verdict of its
+        # slot-ring cursors (vacuously safe in merge mode), and the
+        # communication census (collective count + per-device bytes),
+        # with the offending collective sites in `blame` when refuted.
+        with coord.controller._lock:
+            snap = {
+                df: dict(per)
+                for df, per in (
+                    coord.controller.sharding_verdicts.items()
+                )
+            }
+        from ..analysis.shard_prop import sharding_display
+
+        rows = []
+        for df, per in sorted(snap.items()):
+            for rep, v in sorted(per.items()):
+                census = v.get("census") or {}
+                _ctext, blame = sharding_display(v)
+                rows.append(
+                    (
+                        _enc(df),
+                        _enc(rep),
+                        int(bool(v.get("spmd"))),
+                        int(v.get("workers") or 1),
+                        _enc(str(v.get("ingest_mode") or "")),
+                        int(bool(v.get("safe"))),
+                        int(census.get("collectives") or 0),
+                        int(census.get("bytes") or 0),
+                        _enc(blame),
                     )
                 )
         return rows

@@ -192,6 +192,7 @@ def frontiers(
     span_epochs: dict,
     replica_id: str,
     donation: dict | None = None,
+    sharding: dict | None = None,
 ) -> dict:
     """Replica -> controller frontier report. ``span_epochs`` carries
     each dataflow's monotone COMMITTED span counter (ISSUE 7: the
@@ -201,7 +202,10 @@ def frontiers(
     another round trip). ``donation`` piggybacks each dataflow's
     buffer-provenance/donation verdict (ISSUE 8) whenever it changed —
     the EXPLAIN ANALYSIS and mz_donation surface, shipped only on
-    change so steady state pays nothing."""
+    change so steady state pays nothing. ``sharding`` piggybacks the
+    shard-spec prover's report (ISSUE 9: SPMD-safety verdict, resolved
+    ingest mode, communication census) the same way — the EXPLAIN
+    ANALYSIS ``sharding:`` and mz_sharding surface."""
     msg = {
         "kind": "Frontiers",
         "uppers": uppers,
@@ -211,4 +215,6 @@ def frontiers(
     }
     if donation:
         msg["donation"] = donation
+    if sharding:
+        msg["sharding"] = sharding
     return msg

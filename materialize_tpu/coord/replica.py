@@ -882,6 +882,7 @@ class ReplicaWorker:
         records = {}
         epochs = {}
         donation = {}
+        sharding = {}
         for name, inst in self.dataflows.items():
             upper = inst.view.upper
             if upper != inst.reported_upper:
@@ -908,12 +909,20 @@ class ReplicaWorker:
                 if info is not None:
                     donation[name] = info
                 inst.view._donation_dirty = False
-        if changed or donation:
+            # Shard-spec prover verdicts (ISSUE 9) ride the same way:
+            # shipped once at install (they are a render-time fact),
+            # again only if a rebuild re-renders the dataflow.
+            if inst.view._sharding_dirty:
+                info = inst.view.sharding_info()
+                if info is not None:
+                    sharding[name] = info
+                inst.view._sharding_dirty = False
+        if changed or donation or sharding:
             ctp.send_msg(
                 conn,
                 ctp.frontiers(
                     changed, records, epochs, self.replica_id,
-                    donation=donation,
+                    donation=donation, sharding=sharding,
                 ),
             )
             return True

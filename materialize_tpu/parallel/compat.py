@@ -57,3 +57,23 @@ def require_shard_map():
     if shard_map is None:
         raise NotImplementedError(MISSING_REASON)
     return shard_map
+
+
+def force_host_devices(env=None, n: int = 8) -> None:
+    """Ensure ``XLA_FLAGS`` forces an ``n``-virtual-device host
+    platform, so multi-chip SPMD paths run without TPU hardware. Must
+    run before the jax BACKEND initializes (importing jax is fine: the
+    flag is read at client creation, not at import). Mutates ``env``
+    in place (default ``os.environ``); a pre-existing
+    ``xla_force_host_platform_device_count`` flag wins, so an
+    operator's own device count is respected. The single copy of the
+    idiom shared by tests/conftest.py, ``scripts/check_plans.py
+    --bench``, and the multichip bench fixture."""
+    import os
+
+    target = os.environ if env is None else env
+    flags = target.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        target["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()

@@ -27,8 +27,24 @@ from .lir import (
 INGEST_RING_SLOTS = 8
 
 
+def _spmd_gate(mode: str, spmd: bool, spmd_safe) -> str:
+    """The SPMD slot gate (ISSUE 9): under SPMD, append-slot ingest is
+    enabled only where the shard-spec prover
+    (analysis/shard_prop.py) has verdicted the per-device slot-ring
+    cursor SHARD-LOCAL across the whole step program. ``spmd_safe``
+    is that verdict: True (proven), False (refuted), or None (not yet
+    proven — the conservative answer is merge). Single-device
+    dataflows (spmd=False) are unaffected."""
+    if spmd and mode == "append_slot" and spmd_safe is not True:
+        return "merge"
+    return mode
+
+
 def ingest_mode(
-    state_capacity: int, tail_capacity: int = 1024
+    state_capacity: int,
+    tail_capacity: int = 1024,
+    spmd: bool = False,
+    spmd_safe=None,
 ) -> str:
     """Spine hot-path ingest decision (ISSUE 5 / DBSP discipline: pay
     only for changes). 'append_slot': each arranged delta lands in a
@@ -41,50 +57,63 @@ def ingest_mode(
     ingest tier (>= 8x), i.e. exactly when the per-step O(run0) merge
     would start scaling with state instead of with the delta. Shared
     by EXPLAIN and the render layer (single-source-of-truth contract
-    of this module). SPMD dataflows currently force 'merge': the slot
-    cursor is a replicated scalar that the shard_map boundary specs do
-    not carry (render/dataflow.py ShardedDataflow)."""
+    of this module). SPMD dataflows carry the slot cursor as a sharded
+    per-device ``[devices]`` vector and take append-slot only where
+    the shard-spec abstract interpreter (analysis/shard_prop.py) has
+    PROVEN the cursor shard-local (``spmd_safe=True``, ISSUE 9); an
+    unproven or refuted cursor falls back to merge."""
     from ..utils.dyncfg import (
         ARRANGEMENT_INGEST_MODE,
         COMPUTE_CONFIGS,
     )
 
     mode = ARRANGEMENT_INGEST_MODE(COMPUTE_CONFIGS)
-    if mode != "auto":
-        return mode
-    return (
-        "append_slot"
-        if state_capacity >= 8 * tail_capacity
-        else "merge"
-    )
+    if mode == "auto":
+        mode = (
+            "append_slot"
+            if state_capacity >= 8 * tail_capacity
+            else "merge"
+        )
+    return _spmd_gate(mode, spmd, spmd_safe)
 
 
-def state_ingest_mode(state_capacity: int, tail_capacity: int = 1024) -> str:
+def state_ingest_mode(
+    state_capacity: int,
+    tail_capacity: int = 1024,
+    spmd: bool = False,
+    spmd_safe=None,
+) -> str:
     """Ingest decision for OPERATOR-STATE spines (join/delta-join
-    arrangements). `auto` now resolves by the SAME big-state rule as
-    the output index (ingest_mode): append-slot once the state tier is
+    arrangements). `auto` resolves by the SAME big-state rule as the
+    output index (ingest_mode): append-slot once the state tier is
     >= 8x the ingest tier. The round-6 deferral — auto forced 'merge'
     because regrowing a per-arrangement slot ring through a delta-join
     step program blew the CPU tier probe's budget — is paid off:
     bench_tiers.json was regenerated on this host with slotted
     operator-state spines (ISSUE 7 satellite; doc/perf.md), so the
     measuring process compiles only final-tier programs and the probe
-    cost is a one-time CPU pass. SPMD still forces 'merge' at the
-    render layer (the slot cursor is a replicated scalar the shard_map
-    boundary specs do not carry)."""
+    cost is a one-time CPU pass.
+
+    SPMD no longer unconditionally forces 'merge' (ISSUE 9): the
+    render layer carries a PER-DEVICE slot cursor (a sharded
+    ``[devices]`` vector riding the shard_map boundary specs) wherever
+    the shard-spec prover verdicts it shard-local — pass
+    ``spmd=True, spmd_safe=<verdict>``. An unproven (None) or refuted
+    (False) verdict resolves to merge, with the blame surfaced via
+    ``mz_sharding`` / EXPLAIN ANALYSIS."""
     from ..utils.dyncfg import (
         ARRANGEMENT_INGEST_MODE,
         COMPUTE_CONFIGS,
     )
 
     mode = ARRANGEMENT_INGEST_MODE(COMPUTE_CONFIGS)
-    if mode != "auto":
-        return mode
-    return (
-        "append_slot"
-        if state_capacity >= 8 * tail_capacity
-        else "merge"
-    )
+    if mode == "auto":
+        mode = (
+            "append_slot"
+            if state_capacity >= 8 * tail_capacity
+            else "merge"
+        )
+    return _spmd_gate(mode, spmd, spmd_safe)
 
 
 def plan_reduce(aggregates) -> ReducePlan:

@@ -104,7 +104,8 @@ class _RenderContext:
 
     def __init__(self, source_schemas: dict, num_shards: int = 1,
                  axis_name: str = WORKER_AXIS, slot_cap: int = 256,
-                 join_cap: int = 1024, state_cap: int = 256):
+                 join_cap: int = 1024, state_cap: int = 256,
+                 spmd_safe=None):
         self.source_schemas = source_schemas
         # Initial capacity tier for every stateful operator's
         # arrangements. Overflow growth doubles tiers as needed; callers
@@ -115,15 +116,21 @@ class _RenderContext:
         # Ingest-mode decision for operator-state spines
         # (plan/decisions.py state_ingest_mode, the EXPLAIN-visible
         # source of truth): the number of append slots spine states
-        # are built with, 0 = merge ingest. SPMD forces merge — the
-        # slot cursor is a replicated scalar the shard_map boundary
-        # specs do not carry.
+        # are built with, 0 = merge ingest. Under SPMD the slot cursor
+        # rides the shard_map boundary as a per-device [P] vector,
+        # gated on the shard-spec prover's verdict (ISSUE 9):
+        # ``spmd_safe`` is True only for a render whose cursor the
+        # prover has verdicted (or is about to verdict — the trial
+        # render) shard-local; None/False resolve to merge.
         from ..plan.decisions import INGEST_RING_SLOTS, state_ingest_mode
 
+        self.spmd_safe = spmd_safe
         self.ingest_slots = (
             INGEST_RING_SLOTS
-            if num_shards == 1
-            and state_ingest_mode(state_cap) == "append_slot"
+            if state_ingest_mode(
+                state_cap, spmd=num_shards > 1, spmd_safe=spmd_safe
+            )
+            == "append_slot"
             else 0
         )
         self.slots: list[_StateSlot] = []
@@ -2401,11 +2408,23 @@ class ShardedDataflow(_DataflowBase):
     step (the timely model, SURVEY.md §2.4 row 1). One ``shard_map``-ped
     jitted step per capacity signature. Each worker also maintains its
     own shard of the output arrangement; peeks gather + combine.
+
+    Append-slot ingest under SPMD (ISSUE 9): the slot-ring cursor is
+    carried as a PER-DEVICE ``[P]`` int32 vector riding the shard_map
+    boundary specs like every other state leaf (reshaped to the
+    per-worker scalar inside the step body), which is sound iff the
+    cursor's dataflow is shard-local — worker p's cursor depends only
+    on worker p's inputs. The shard-spec abstract interpreter
+    (analysis/shard_prop.py) PROVES that property over the rendered
+    step program; a refuted (or unprovable) cursor re-renders in
+    merge-ingest mode, with the blame surfaced via
+    ``sharding_report()`` / ``mz_sharding`` / EXPLAIN ANALYSIS.
     """
 
     def __init__(self, expr: mir.RelationExpr, mesh, name: str = "df",
                  slot_cap: int = 256, input_shard_cap: int = 1024,
-                 output_cap: int = 256, state_cap: int = 256):
+                 output_cap: int = 256, state_cap: int = 256,
+                 out_levels: int = 2, out_slots: int | None = None):
         from ..expr import strings
 
         self.expr = expr
@@ -2420,28 +2439,97 @@ class ShardedDataflow(_DataflowBase):
         self.axis_name = mesh.axis_names[0]
         self.num_shards = int(mesh.shape[self.axis_name])
         self.out_schema = expr.schema()
+        self.input_shard_cap = input_shard_cap
+        self._sharding = worker_sharding(mesh, self.axis_name)
+        self._slot_cap0 = slot_cap
+        self._output_cap = output_cap
+        self._state_cap = state_cap
+        self._out_levels = out_levels
+        self._requested_out_slots = out_slots
+        self._shard_prop_report: dict | None = None
+        # TRIAL render, prover gate, fallback (ISSUE 9): render as if
+        # the cursor proof will succeed; when any spine actually took
+        # a slot ring, run the shard-spec prover over the rendered
+        # step program and keep the ring only on a SAFE verdict —
+        # otherwise re-render in merge mode. Dataflows whose ingest
+        # decision is merge anyway (the common small-state case) never
+        # pay the abstract trace.
+        self._render(spmd_safe=True)
+        from ..analysis.shard_prop import _has_slot_cursors
+
+        if _has_slot_cursors(self):
+            from ..analysis.shard_prop import sharded_step_report
+
+            report = sharded_step_report(self)
+            self._shard_prop_report = report
+            if not report["safe"]:
+                self._render(spmd_safe=False)
+                self._shard_prop_report = dict(
+                    report, ingest_mode="merge"
+                )
+
+    def _render(self, spmd_safe) -> None:
+        """One full render at the given prover assumption (the ingest
+        decisions consult ``spmd_safe`` through
+        plan/decisions.state_ingest_mode — the EXPLAIN-visible source
+        of truth)."""
         ctx = _RenderContext(
             {}, num_shards=self.num_shards, axis_name=self.axis_name,
-            slot_cap=slot_cap, state_cap=state_cap,
+            slot_cap=self._slot_cap0, state_cap=self._state_cap,
+            spmd_safe=spmd_safe,
         )
-        self._run = _build(expr, ctx)
+        self._run = _build(self.expr, ctx)
         # Basic aggregates work sharded: the reduce input exchange keys
         # every group to exactly one worker, so the per-worker multiset
         # shards are group-disjoint and _basic_multiset_host's gather
         # yields a group-contiguous multiset for edge finalization.
-        self._basic_finalizers = _resolve_basic_sites(expr, ctx)
+        self._basic_finalizers = _resolve_basic_sites(self.expr, ctx)
         self._ctx = ctx
-        self.input_shard_cap = input_shard_cap
-        self._sharding = worker_sharding(mesh, self.axis_name)
+        out_slots = self._requested_out_slots
+        if out_slots is None:
+            from ..plan.decisions import INGEST_RING_SLOTS, ingest_mode
+
+            out_slots = (
+                INGEST_RING_SLOTS
+                if ingest_mode(
+                    self._state_cap,
+                    ctx.out_delta_cap,
+                    spmd=True,
+                    spmd_safe=spmd_safe,
+                )
+                == "append_slot"
+                else 0
+            )
+        elif out_slots and spmd_safe is not True:
+            # An explicitly requested ring is still prover-gated under
+            # SPMD: a refuted cursor falls back to merge (correctness
+            # beats the request; sharding_report carries the blame).
+            out_slots = 0
         # Per-shard states, stored as global arrays [P * cap] / counts [P].
         self.states = [
             self._replicate_empty(s.init) for s in ctx.slots
         ]
-        self._init_output(output_cap)
+        self._init_output(
+            self._output_cap, levels=self._out_levels, slots=out_slots
+        )
         self.output = self._replicate_empty_one(self.output)
         self.err_output = self._replicate_empty_one(self.err_output)
         self.time = 0
         self._remake_jit()
+
+    def sharding_report(self) -> dict:
+        """The shard-spec prover's report over this dataflow's step
+        program (ISSUE 9): communication census, per-cursor
+        SPMD-safety verdicts, resolved ingest mode. Computed eagerly
+        when a slot ring was requested (it gates the enablement),
+        lazily for merge-mode dataflows; cached — surfaces
+        (mz_sharding, EXPLAIN ANALYSIS, bench --multichip) read it
+        for free after the first call."""
+        if self._shard_prop_report is None:
+            from ..analysis.shard_prop import sharded_step_report
+
+            self._shard_prop_report = sharded_step_report(self)
+        return self._shard_prop_report
 
     # -- sharded state layout ----------------------------------------------
     def _replicate_empty(self, parts: tuple) -> tuple:
@@ -2450,8 +2538,18 @@ class ShardedDataflow(_DataflowBase):
 
     def _replicate_empty_one(self, obj):
         """Each worker starts with an empty shard of this arrangement
-        (or of each run of a spine)."""
-        return obj.map_batches(self._rep_batch)
+        (or of each run of a spine). A slot-ring cursor becomes a
+        PER-DEVICE [P] vector (each worker owns a private ring cursor;
+        the shard-spec prover guarantees it stays shard-local)."""
+        out = obj.map_batches(self._rep_batch)
+        if isinstance(out, Spine) and out.cursor is not None:
+            out = out.with_cursor(
+                jax.device_put(
+                    np.zeros(self.num_shards, np.int32),
+                    self._sharding,
+                )
+            )
+        return out
 
     def _rep_batch(self, b: Batch) -> Batch:
         P_ = self.num_shards
@@ -2506,23 +2604,32 @@ class ShardedDataflow(_DataflowBase):
         )
 
     # -- the SPMD step ------------------------------------------------------
+    # Boundary rank adjustment: counts (and the slot cursor) cross the
+    # shard_map boundary rank-1 ([1] per worker from the global [P])
+    # and run the step body as scalars.
     @staticmethod
     def _scalar_counts(s: tuple) -> tuple:
-        return tuple(
-            o.map_batches(
+        def fix(o):
+            o = o.map_batches(
                 lambda b: b.replace(count=b.count.reshape(()))
             )
-            for o in s
-        )
+            if isinstance(o, Spine) and o.cursor is not None:
+                o = o.with_cursor(o.cursor.reshape(()))
+            return o
+
+        return tuple(fix(o) for o in s)
 
     @staticmethod
     def _vec_counts(s: tuple) -> tuple:
-        return tuple(
-            o.map_batches(
+        def fix(o):
+            o = o.map_batches(
                 lambda b: b.replace(count=b.count.reshape((1,)))
             )
-            for o in s
-        )
+            if isinstance(o, Spine) and o.cursor is not None:
+                o = o.with_cursor(o.cursor.reshape((1,)))
+            return o
+
+        return tuple(fix(o) for o in s)
 
     def _remake_jit(self):
         axis = self.axis_name
@@ -2603,6 +2710,10 @@ class ShardedDataflow(_DataflowBase):
                     check_vma=False,
                 )(states, output, err_output, inputs, time)
 
+        # The raw (un-jitted) step: the shard-spec abstract
+        # interpreter traces it to reach the shard_map eqn's boundary
+        # specs (analysis/shard_prop.trace_sharded_step).
+        self._step_fn = step
         self._step_jit = jax.jit(step)
 
     def run_span(self, inputs_list: list, donate: bool = False):
@@ -2611,18 +2722,21 @@ class ShardedDataflow(_DataflowBase):
             "dataflows pipeline through run_steps(defer_check=True) + "
             "flags snapshots instead (the shard_map step is already "
             "one dispatch per step, and its packed flags ride the "
-            "same deferred logical_or accumulator) — see ROADMAP "
-            "item 2 for the sharded slot-ring/span design"
+            "same deferred logical_or accumulator) — with slot-ring "
+            "ingest now prover-gated under SPMD (ISSUE 9), the "
+            "remaining span work is the scan-over-chunks program, "
+            "see ROADMAP item 2"
         )
 
     def _donated_step_program(self, parts: tuple):
         raise NotImplementedError(
             "SPMD dataflows do not donate their carry: the per-worker "
             "shard layout rides shard_map boundary specs that "
-            "donate_argnums cannot alias through (and the slot-cursor "
-            "limitation of ROADMAP item 2 keeps SPMD on merge ingest "
-            "anyway) — the view layer routes SPMD views to the "
-            "un-donated per-tick path"
+            "donate_argnums cannot alias through — the view layer "
+            "routes SPMD views to the un-donated per-tick path. (The "
+            "old second blocker — SPMD forcing merge ingest — is "
+            "gone: the shard-spec prover now gates a per-device "
+            "slot ring, ISSUE 9.)"
         )
 
     def _make_compact_jit(self, max_level: int = 10**9):

@@ -35,6 +35,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The sharding gates (--bench, ISSUE 9) render the bench configs SPMD
+# over an 8-virtual-device CPU mesh; force the device count before the
+# jax backend initializes.
+from materialize_tpu.parallel.compat import force_host_devices  # noqa: E402
+
+force_host_devices()
 
 
 def _iter_plan_exprs(plan):
@@ -296,7 +302,142 @@ def run_bench_mode(verbose: bool) -> int:
     hs = lint_hot_path()
     gate("host-sync-hot-path", None, hs, 0)
     rc |= run_donation_gates(gate)
+    rc |= run_sharding_gates(gate, budgets)
     rc |= run_lockcheck_smoke(gate)
+    return rc
+
+
+def sharded_bench_dataflows(mesh) -> dict:
+    """name -> ShardedDataflow factory for the SPMD sharding gates:
+    the same three budget-gated configs as bench_dataflows, rendered
+    over the worker mesh (pure renders + abstract traces, nothing
+    compiles)."""
+    from materialize_tpu.expr import relation as mir
+    from materialize_tpu.render.dataflow import ShardedDataflow
+    from materialize_tpu.storage.generator.tpch import LINEITEM_SCHEMA
+    from materialize_tpu.transform.optimizer import optimize
+    from materialize_tpu.workloads.tpch import q1_mir, q15_mir
+
+    return {
+        "index": lambda: ShardedDataflow(
+            mir.Get("lineitem", LINEITEM_SCHEMA), mesh, name="index",
+            out_levels=4, out_slots=4,
+        ),
+        "q1": lambda: ShardedDataflow(
+            optimize(q1_mir()), mesh, name="q1"
+        ),
+        "q15": lambda: ShardedDataflow(
+            optimize(q15_mir()), mesh, name="q15"
+        ),
+    }
+
+
+def run_sharding_gates(gate, budgets: dict) -> int:
+    """The shard-spec prover gates (ISSUE 9), over the sharded renders
+    of index/q1/q15:
+
+    - ``spmd-safety``: every slot-ring cursor must be PROVEN
+      shard-local (the verdict that gates append-slot ingest under
+      SPMD), and the index config must actually resolve to the slot
+      ring — a regression that silently falls back to merge-mode
+      O(run0) ingest fails here, statically;
+    - ``comm-budget``: the step program's communication census
+      (collective count, per-kind counts, per-device byte volume) must
+      stay within the checked-in budgets
+      (tests/kernel_budget.json ``<config>_comm``). A kind absent from
+      the budget allows ZERO sites — a collective sneaking into a
+      shard-local stage (the index ingest path budgets nothing but the
+      packed-flags psum) is a static CI failure, before any multi-chip
+      run."""
+    import jax
+
+    from materialize_tpu.analysis import LintFinding
+    from materialize_tpu.parallel import compat
+
+    if not compat.HAS_SHARD_MAP:
+        print(f"sharding gates: skipped ({compat.MISSING_REASON})")
+        return 0
+    if len(jax.devices()) < 8:
+        print(
+            "sharding gates: skipped "
+            f"(need 8 devices, have {len(jax.devices())})"
+        )
+        return 0
+    from materialize_tpu.parallel.mesh import make_mesh
+
+    rc = 0
+    mesh = make_mesh(8)
+    for name, mk in sharded_bench_dataflows(mesh).items():
+        sdf = mk()
+        rep = sdf.sharding_report()
+        sf = []
+        if not rep["safe"]:
+            blames = "; ".join(
+                b
+                for cur in rep.get("cursors", ())
+                for b in cur.get("blame", ())
+            ) or str(rep.get("error"))
+            sf.append(
+                LintFinding(
+                    "spmd-safety",
+                    name,
+                    "slot-ring cursor not provably shard-local "
+                    f"({blames}) — SPMD falls back to O(run0) merge "
+                    "ingest",
+                )
+            )
+        if name == "index" and rep["ingest_mode"] != "append_slot":
+            sf.append(
+                LintFinding(
+                    "spmd-safety",
+                    name,
+                    "index config no longer resolves to prover-gated "
+                    "append-slot ingest under SPMD (got "
+                    f"{rep['ingest_mode']!r}): multi-chip ingest "
+                    "regressed to O(run0) per step",
+                )
+            )
+        gate(f"{name}-spmd-safety", None, sf, 0)
+        budget = budgets.get(f"{name}_comm")
+        census = rep["census"]
+        cf = []
+        if budget is not None:
+            if census["collectives"] > budget["collectives"]:
+                cf.append(
+                    LintFinding(
+                        "comm-budget",
+                        name,
+                        f"{census['collectives']} collective site(s), "
+                        f"budget {budget['collectives']} "
+                        "(tests/kernel_budget.json): a change added "
+                        "communication to the step program. Remove it "
+                        "or consciously raise the budget in this PR.",
+                    )
+                )
+            if census["bytes"] > budget["bytes"]:
+                cf.append(
+                    LintFinding(
+                        "comm-budget",
+                        name,
+                        f"{census['bytes']} B per-device collective "
+                        f"volume, budget {budget['bytes']} B",
+                    )
+                )
+            allowed = budget.get("kinds", {})
+            for kind, n in sorted(census["kinds"].items()):
+                if n > allowed.get(kind, 0):
+                    cf.append(
+                        LintFinding(
+                            "comm-budget",
+                            name,
+                            f"unexpected collective {kind!r} x{n} "
+                            f"(budget {allowed.get(kind, 0)}): a "
+                            "collective entered a stage budgeted "
+                            "shard-local",
+                        )
+                    )
+        gate(f"{name}-comm-budget", None, cf, 0)
+        rc |= 1 if (sf or cf) else 0
     return rc
 
 

@@ -5,11 +5,9 @@ cluster: clusterd-test-driver / mzcompose)."""
 
 import os
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+from materialize_tpu.parallel.compat import force_host_devices
+
+force_host_devices()
 
 # The axon TPU plugin ignores the JAX_PLATFORMS env var; the config knob wins.
 import jax  # noqa: E402
@@ -97,6 +95,31 @@ def pytest_configure(config):
 # segfaults in concurrent XLA compile-cache loads. Track every worker
 # created during a test and stop it at teardown.
 import pytest  # noqa: E402
+
+
+# -- the forced-multi-device analysis lane (ISSUE 9) -------------------------
+# The shard-spec prover tests (`pytest -m analysis`) run against a real
+# 8-worker mesh on the forced CPU platform above. The fixture skips
+# cleanly on JAX builds without any shard_map API, and where the
+# platform could not actually be forced to 8 devices (e.g. a TPU
+# plugin that ignores the flag).
+
+
+@pytest.fixture
+def eight_worker_mesh():
+    import jax
+
+    from materialize_tpu.parallel import compat
+
+    if not compat.HAS_SHARD_MAP:
+        pytest.skip(compat.MISSING_REASON)
+    if len(jax.devices()) < 8:
+        pytest.skip(
+            f"need 8 forced devices, have {len(jax.devices())}"
+        )
+    from materialize_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(8)
 
 
 @pytest.fixture(autouse=True)

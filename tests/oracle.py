@@ -22,6 +22,17 @@ def consolidate_rows(rows):
     return sorted(out)
 
 
+def net_rows(rows):
+    """(col..., time, diff) rows -> sorted (col..., net_diff) with
+    zero nets dropped. Times collapse: shards may hold the same row at
+    different times, so sharded-vs-single-device equivalence claims
+    compare maintained CONTENT (net multiplicity per value row)."""
+    acc = defaultdict(int)
+    for r in rows:
+        acc[r[:-2]] += r[-1]
+    return sorted(k + (d,) for k, d in acc.items() if d != 0)
+
+
 def as_multiset(rows):
     """Collapse times: (col..., time, diff) -> {(col...): total_diff}."""
     acc = defaultdict(int)
