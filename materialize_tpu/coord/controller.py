@@ -21,12 +21,42 @@ import time as _time
 from collections import deque
 
 from . import protocol as ctp
-from .peek import ServerBusy
+from ..utils import retry as retry_mod
+from .peek import PeekTimedOut, ServerBusy
 from .protocol import DataflowDescription
 
-# Batched gathers wait for dataflow frontiers like ordinary peeks; the
-# resolver bound mirrors the coordinator's PEEK_TIMEOUT.
-_BATCH_RESOLVE_TIMEOUT = 180.0
+
+def _batch_resolve_timeout() -> float:
+    """Batched gathers wait for dataflow frontiers like ordinary
+    peeks; the resolver budget is the unified peek retry policy
+    (retry_policy_peek, mirroring the coordinator's PEEK_TIMEOUT)."""
+    b = retry_mod.policy("peek").budget
+    return b if b > 0 else 180.0
+
+
+class _NonceSource:
+    """Strictly-increasing Hello nonces, with fast-forward: a
+    HelloReject carries the replica's current epoch, and the next
+    connect must jump PAST it instead of linearly probing one nonce
+    per backoff cycle — a restarted controller (nonce counter reset to
+    0) would otherwise take O(previous session count) reconnect rounds
+    to re-fence a surviving replica (ISSUE 10: recovery time is a
+    counted metric now)."""
+
+    def __init__(self):
+        self._next = 1
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            n = self._next
+            self._next += 1
+            return n
+
+    def bump_past(self, epoch: int) -> None:
+        with self._lock:
+            if epoch >= self._next:
+                self._next = epoch + 1
 
 
 _WAITER_TLS = threading.local()
@@ -38,7 +68,10 @@ class _PeekWaiter:
     allocating an Event + its lock per request is measurable at
     thousands of lookups per second."""
 
-    __slots__ = ("probe", "as_of", "event", "rows", "served_at", "error")
+    __slots__ = (
+        "probe", "as_of", "event", "rows", "served_at", "error",
+        "retryable",
+    )
 
     def __init__(self, probe: tuple, as_of: int):
         self.probe = probe
@@ -52,6 +85,9 @@ class _PeekWaiter:
         self.rows = None
         self.served_at = None
         self.error = None
+        # Timeouts and sheds are RETRYABLE (surfaced as ServerBusy at
+        # pgwire/HTTP); replica-reported evaluation errors are not.
+        self.retryable = False
 
 
 class _PeekBatch:
@@ -168,10 +204,13 @@ class PeekBatcher:
                 # (thread-reused) event; detach it so the thread's next
                 # lookup cannot be spuriously woken.
                 _WAITER_TLS.event = None
-                raise TimeoutError(
-                    f"fast-path peek on {dataflow!r} timed out"
+                raise PeekTimedOut(
+                    f"server busy: fast-path peek on {dataflow!r} "
+                    "timed out; retry"
                 )
         if w.error is not None:
+            if w.retryable:
+                raise PeekTimedOut(f"server busy: {w.error}; retry")
             raise RuntimeError(w.error)
         return w.rows, w.served_at
 
@@ -249,7 +288,7 @@ class PeekBatcher:
         for (df, bound_cols, scan), ws in dispatches:
             batch = self._dispatch_group(df, bound_cols, scan, ws)
             self._resolver_pool.submit(
-                self._resolve_batch, batch, _BATCH_RESOLVE_TIMEOUT
+                self._resolve_batch, batch, _batch_resolve_timeout()
             )
 
     def _dispatch_group(
@@ -281,9 +320,11 @@ class PeekBatcher:
         ctrl = self.ctrl
         resp = None
         error = None
+        retryable = False
         try:
             if not batch.event.wait(timeout):
                 error = "batched peek timed out"
+                retryable = True
             else:
                 with ctrl._lock:
                     resp = ctrl._peek_results.pop(batch.peek_id, None)
@@ -301,6 +342,7 @@ class PeekBatcher:
         if error is not None:
             for w in batch.waiters:
                 w.error = error
+                w.retryable = retryable
                 w.event.set()
             return
         groups = resp.get("rows_groups") or []
@@ -325,6 +367,7 @@ class PeekBatcher:
         for ws in groups.values():
             for w in ws:
                 w.error = why
+                w.retryable = True  # shutdown/failover: client retries
                 w.event.set()
 
     def snapshot(self) -> dict:
@@ -341,7 +384,10 @@ class PeekBatcher:
 class ReplicaClient:
     """Background connection owner for one replica: connect, Hello,
     replay history, then stream commands; responses land in the
-    controller's shared queue tagged with the replica name."""
+    controller's shared queue tagged with the replica name. Sessions,
+    reconnects, and observed fencings are counted (the mz_recovery /
+    /metrics surface: recovery time and failover behavior are counted
+    invariants, not vibes)."""
 
     def __init__(
         self,
@@ -349,7 +395,7 @@ class ReplicaClient:
         addr: tuple[str, int],
         history_fn,
         response_q: queue.Queue,
-        nonce_counter,
+        nonce_counter: _NonceSource,
     ):
         self.name = name
         self.addr = addr
@@ -359,6 +405,8 @@ class ReplicaClient:
         self._cmd_q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self.connected = threading.Event()
+        self.sessions = 0  # established sessions (reconnects = n-1)
+        self.fenced = 0  # HelloRejects observed (newer epoch exists)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -370,17 +418,21 @@ class ReplicaClient:
 
     # -- connection loop ----------------------------------------------------
     def _run(self) -> None:
-        backoff = 0.05
+        stream = retry_mod.policy("reconnect").stream()
         while not self._stop.is_set():
             try:
                 self._session()
-                backoff = 0.05
+                stream = retry_mod.policy("reconnect").stream()
             except (OSError, ctp.TransportError):
                 pass
             self.connected.clear()
             if not self._stop.is_set():
-                _time.sleep(backoff)
-                backoff = min(backoff * 2, 2.0)
+                # Unbounded: reconnect never gives up (an expired
+                # attempts/budget must back off at the ceiling, not
+                # return a 0.0 sleep and busy-spin); 1ms floor guards
+                # a base=0 misconfiguration the same way.
+                stream.advance()
+                _time.sleep(max(stream.next_sleep_unbounded(), 0.001))
 
     def _session(self) -> None:
         sock = socket.create_connection(self.addr, timeout=5.0)
@@ -391,11 +443,23 @@ class ReplicaClient:
             # the hidden floor under every peek round trip.
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.settimeout(None)
-            nonce = next(self._nonce_counter)
+            nonce = self._nonce_counter.next()
             ctp.send_msg(sock, ctp.hello(nonce))
             resp = ctp.recv_msg(sock)
             if resp.get("kind") != "HelloOk":
+                if resp.get("kind") == "HelloReject":
+                    # Fast-forward past the fencing epoch: the next
+                    # attempt must win immediately, not probe one
+                    # nonce per backoff cycle (recovery time).
+                    self.fenced += 1
+                    retry_mod.fenced_epochs_total().inc()
+                    self._nonce_counter.bump_past(
+                        int(resp.get("epoch", 0))
+                    )
                 raise ctp.TransportError(f"hello rejected: {resp}")
+            self.sessions += 1
+            if self.sessions > 1:
+                retry_mod.reconnects_total().inc()
             # Rehydration: replay the compacted history. The replica
             # reconciles (keeps unchanged dataflows) and drops the rest.
             history, live = self._history_fn()
@@ -428,14 +492,17 @@ class ReplicaClient:
             if dead.is_set():
                 raise ctp.TransportError("replica connection lost")
         finally:
-            sock.close()
+            # hard_close: the reader thread is blocked in recv on this
+            # socket — a deferred close would leak the thread AND keep
+            # the replica-side session half-alive.
+            ctp.hard_close(sock)
 
 
 class ComputeController:
     """Desired-state owner for one compute instance (cluster)."""
 
     def __init__(self):
-        self._nonce_counter = itertools.count(1)
+        self._nonce_counter = _NonceSource()
         self._peek_counter = itertools.count(1)
         self.responses: queue.Queue = queue.Queue()
         self.replicas: dict[str, ReplicaClient] = {}
@@ -466,6 +533,12 @@ class ComputeController:
         # ingest mode, communication census. Surfaced by EXPLAIN
         # ANALYSIS's `sharding:` block and the mz_sharding relation.
         self.sharding_verdicts: dict[str, dict[str, dict]] = {}
+        # Recovery accounting (ISSUE 10, df -> replica -> counters):
+        # each replica's install/rebuild/reconcile counts piggyback on
+        # Frontiers whenever they change. `rebuilds == 0` for a
+        # fingerprint-unchanged dataflow across a controller restart
+        # is THE counted reconciliation invariant (mz_recovery).
+        self.recovery_stats: dict[str, dict[str, dict]] = {}
         self.statuses: deque = deque(maxlen=1000)  # replica error reports
         # Install acks: df name -> replica -> error string | None (ok).
         self.install_acks: dict[str, dict] = {}
@@ -528,6 +601,8 @@ class ComputeController:
                 per_df.pop(name, None)
             for per_df in self.sharding_verdicts.values():
                 per_df.pop(name, None)
+            for per_df in self.recovery_stats.values():
+                per_df.pop(name, None)
 
     def _history_snapshot(self):
         with self._lock:
@@ -549,12 +624,20 @@ class ComputeController:
             self.install_acks.pop(desc.name, None)
         self._broadcast(cmd)
 
-    def wait_installed(self, name: str, timeout: float = 30.0) -> None:
+    def wait_installed(
+        self, name: str, timeout: float | None = None
+    ) -> None:
         """Block until some replica acks the install (ok), or raise the
         replica-reported error once every connected replica has failed
         it. Surfaces bad plans at DDL time instead of as a later
         "no such dataflow" peek error. No replicas -> returns (the
-        dataflow installs on the next replica connect via history)."""
+        dataflow installs on the next replica connect via history).
+        Budget + poll cadence come from ``retry_policy_install_wait``;
+        an explicit ``timeout`` overrides the budget."""
+        pol = retry_mod.policy("install_wait")
+        if timeout is None:
+            timeout = pol.budget if pol.budget > 0 else 30.0
+        poll = max(pol.base, 0.001)
         deadline = _time.monotonic() + timeout
         while True:
             # Only CONNECTED replicas owe an ack: a dead/reconnecting
@@ -577,7 +660,7 @@ class ComputeController:
                 if acks:
                     raise RuntimeError(next(iter(acks.values())))
                 return  # slow hydration is not an error
-            _time.sleep(0.005)
+            _time.sleep(poll)
 
     def drop_dataflow(self, name: str) -> None:
         with self._lock:
@@ -587,6 +670,7 @@ class ComputeController:
             self.span_epochs.pop(name, None)
             self.donation_verdicts.pop(name, None)
             self.sharding_verdicts.pop(name, None)
+            self.recovery_stats.pop(name, None)
             self.install_acks.pop(name, None)
         self._broadcast(ctp.drop_dataflow(name))
 
@@ -610,8 +694,13 @@ class ComputeController:
         self._broadcast(ctp.peek(peek_id, dataflow, as_of, exact))
         try:
             if not ev.wait(timeout):
-                raise TimeoutError(
-                    f"peek {peek_id} on {dataflow!r} timed out"
+                # Retryable by contract (ISSUE 10 satellite): the front
+                # ends shed this as ServerBusy (53400 / 503), and the
+                # sequencing lock was released around the wait, so a
+                # timed-out peek never poisons later statements.
+                raise PeekTimedOut(
+                    f"server busy: peek {peek_id} on {dataflow!r} "
+                    "timed out; retry"
                 )
             with self._lock:
                 resp = self._peek_results.pop(peek_id)
@@ -686,6 +775,10 @@ class ComputeController:
                             self.sharding_verdicts.setdefault(df, {})[
                                 replica
                             ] = v
+                        for df, v in msg.get("recovery", {}).items():
+                            self.recovery_stats.setdefault(df, {})[
+                                replica
+                            ] = v
             elif kind == "Status":
                 with self._lock:
                     self.statuses.append(msg)
@@ -730,18 +823,43 @@ class ComputeController:
             return max(per.values()) if per else 0
 
     def wait_frontier(
-        self, dataflow: str, past: int, timeout: float = 30.0
+        self, dataflow: str, past: int, timeout: float | None = None
     ) -> int:
+        pol = retry_mod.policy("frontier_wait")
+        if timeout is None:
+            timeout = pol.budget if pol.budget > 0 else 30.0
+        poll = max(pol.base, 0.001)
         deadline = _time.monotonic() + timeout
         while _time.monotonic() < deadline:
             f = self.any_frontier(dataflow)
             if f > past:
                 return f
-            _time.sleep(0.005)
+            _time.sleep(poll)
         raise TimeoutError(
             f"frontier of {dataflow!r} stuck at "
-            f"{self.any_frontier(dataflow)} (wanted > {past})"
+            f"{self.any_frontier(dataflow)} (wanted > {past}); retry"
         )
+
+    def recovery_snapshot(self) -> dict:
+        """Recovery observability (the mz_recovery relation's
+        controller half): per-replica session/fence counters and the
+        per-dataflow install/rebuild/reconcile counts the replicas
+        piggyback on their frontier reports."""
+        with self._lock:
+            dataflows = {
+                df: {rep: dict(v) for rep, v in per.items()}
+                for df, per in self.recovery_stats.items()
+            }
+        replicas = {
+            name: {
+                "sessions": rc.sessions,
+                "reconnects": max(rc.sessions - 1, 0),
+                "fenced": rc.fenced,
+                "connected": rc.connected.is_set(),
+            }
+            for name, rc in self.replicas.items()
+        }
+        return {"replicas": replicas, "dataflows": dataflows}
 
     def shutdown(self) -> None:
         self._stop.set()

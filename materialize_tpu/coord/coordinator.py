@@ -59,8 +59,19 @@ from .protocol import DataflowDescription
 from .sources import GeneratorSource
 
 # Peeks wait for dataflow frontiers; first-compile latency on a fresh
-# replica can be tens of seconds (XLA), so the bound is generous.
+# replica can be tens of seconds (XLA), so the default bound is
+# generous. The live value comes from the unified retry policy
+# (`retry_policy_peek`, utils/retry.py) so operators — and the chaos
+# tests — can retune the budget at runtime; exhaustion surfaces as the
+# retryable ServerBusy shed (53400 / 503), never a generic error.
 PEEK_TIMEOUT = 180.0
+
+
+def _peek_timeout() -> float:
+    from ..utils.retry import policy as _retry_policy
+
+    b = _retry_policy("peek").budget
+    return b if b > 0 else PEEK_TIMEOUT
 
 CATALOG_SHARD = "mz_catalog"
 CATALOG_SCHEMA = Schema([Column("item", ColumnType.STRING)])
@@ -148,7 +159,28 @@ class Coordinator:
             self.catalog.create(
                 CatalogItem(name=name, kind="introspection", schema=schema)
             )
+        # Recovery report (ISSUE 10): what this boot replayed from the
+        # durable catalog and how long it took — surfaced via
+        # mz_recovery, EXPLAIN ANALYSIS's `recovery:` block, /metrics,
+        # and environmentd --recover.
+        self.recovery: dict = {
+            "catalog_replayed": 0.0,
+            "dyncfg_replayed": 0.0,
+            "replay_failures": 0.0,
+            "recovery_ms": 0.0,
+        }
+        # var -> its live durable {"set": var} record, so a later SET
+        # retracts the prior override in O(1) instead of re-reading
+        # the whole catalog shard under the sequencing lock.
+        self._dyncfg_records: dict[str, dict] = {}
+        t0 = _time.monotonic()
         self._bootstrap()
+        self.recovery["recovery_ms"] = (_time.monotonic() - t0) * 1e3
+        from ..utils import retry as _retry_mod
+
+        _retry_mod.recovery_seconds().set(
+            self.recovery["recovery_ms"] / 1e3
+        )
 
     def _unlocked(self):
         """Release the sequencing lock around a blocking wait (peek
@@ -269,6 +301,52 @@ class Coordinator:
                 lines.append(line)
         return "\n".join(lines)
 
+    def _recovery_analysis_text(self) -> str:
+        """Crash-recovery observability (the EXPLAIN ANALYSIS
+        `recovery:` block, ISSUE 10; mz_recovery serves the same rows
+        relationally): what the last boot replayed, each replica's
+        session/fence counters, and the per-dataflow
+        install/rebuild/reconcile counts — reconciliation as a counted
+        invariant (rebuilds == 0 across restart when fingerprints are
+        unchanged)."""
+        r = self.recovery
+        coord_line = (
+            "  coordinator: "
+            f"catalog_replayed={int(r['catalog_replayed'])} "
+            f"dyncfg_replayed={int(r['dyncfg_replayed'])} "
+            f"replay_failures={int(r['replay_failures'])}"
+        )
+        # recovery_ms is wall-clock; on a fresh boot (nothing replayed)
+        # it measures bootstrap overhead, not recovery, so EXPLAIN
+        # omits it to stay deterministic for SLT — mz_recovery always
+        # serves it relationally.
+        if any((r["catalog_replayed"], r["dyncfg_replayed"],
+                r["replay_failures"])):
+            coord_line += f" recovery_ms={r['recovery_ms']:.1f}"
+        lines = ["recovery:", coord_line]
+        snap = self.controller.recovery_snapshot()
+        for name, st in sorted(snap["replicas"].items()):
+            lines.append(
+                f"  replica {name}: sessions={st['sessions']} "
+                f"reconnects={st['reconnects']} "
+                f"fenced={st['fenced']} "
+                f"connected={str(bool(st['connected'])).lower()}"
+            )
+        named = {it.name for it in self.catalog.items.values()}
+        named |= set(self.peekable.values())
+        for df, per in sorted(snap["dataflows"].items()):
+            if df not in named:
+                continue
+            for rep, v in sorted(per.items()):
+                lines.append(
+                    f"  {df}@{rep}: "
+                    f"installs={int(v.get('installs', 0))} "
+                    f"rebuilds={int(v.get('rebuilds', 0))} "
+                    f"reconciles={int(v.get('reconciles', 0))} "
+                    f"hydrate_ms={float(v.get('hydrate_ms', 0)):.1f}"
+                )
+        return "\n".join(lines)
+
     # -- durable catalog ----------------------------------------------------
     def _catalog_append(self, record: dict, diff: int) -> None:
         self._net_durable += 1 if diff > 0 else -1
@@ -304,6 +382,8 @@ class Coordinator:
         """Replay the durable catalog: re-plan every recorded DDL in id
         order (bootstrap, adapter/src/coord.rs; dataflow as-ofs are
         re-selected by the replicas on CreateDataflow)."""
+        from ..utils import retry as _retry_mod
+
         for rec in self._catalog_live_records():
             self._item_seq = max(self._item_seq, rec["id"])
             try:
@@ -313,6 +393,8 @@ class Coordinator:
                     replay=True,
                     record=rec,
                 )
+                self.recovery["catalog_replayed"] += 1
+                _retry_mod.catalog_replayed_total().inc()
             except Exception as e:
                 # A record that no longer replays (e.g. its install was
                 # compensated mid-crash) must not brick the boot:
@@ -326,6 +408,7 @@ class Coordinator:
                         f"failed ({e!r}); record retracted",
                     }
                 )
+                self.recovery["replay_failures"] += 1
                 self._catalog_append(rec, -1)
 
     # -- statement execution -------------------------------------------------
@@ -405,11 +488,52 @@ class Coordinator:
                     f"unknown system variable {plan.name!r}"
                 )
             try:
+                if plan.name.startswith("retry_policy_"):
+                    # Validate the spec NOW: a malformed spec that
+                    # reached the durable catalog would raise at
+                    # policy() time inside a reconnect daemon thread
+                    # — on this boot and every --recover after it.
+                    from ..utils.retry import RetryPolicy
+
+                    RetryPolicy.parse(plan.value)
                 self.update_config({plan.name: plan.value})
             except (TypeError, ValueError) as e:
                 raise PlanError(
                     f"invalid value for {plan.name!r}: {e}"
                 ) from e
+            # Dyncfg overrides are part of the durable catalog
+            # (ISSUE 10): a restarted coordinator must come back with
+            # the same flags (span pipelining, ingest mode, retry
+            # policies), or recovery silently changes behavior. Later
+            # SETs of the same var retract the earlier record, so boot
+            # replays exactly the newest override per var (tracked in
+            # _dyncfg_records so retraction is O(1), not a full
+            # catalog scan per SET).
+            if replay:
+                self.recovery["dyncfg_replayed"] += 1
+                if record is not None:
+                    # Two live records for one var = a crash landed
+                    # between append-new and retract-prior below.
+                    # Replay runs in id order so this newer record
+                    # wins; retract the orphaned older one now
+                    # (self-healing, like failed-replay retraction).
+                    stale = self._dyncfg_records.pop(plan.name, None)
+                    if stale is not None:
+                        self._catalog_append(stale, -1)
+                    self._dyncfg_records[plan.name] = record
+            else:
+                # Append the NEW record before retracting the prior
+                # one: a crash between the two durable writes must
+                # leave the override present (two live records replay
+                # newest-wins), never absent — losing an acknowledged
+                # SET across restart is exactly the bug class this
+                # catalog exists to prevent.
+                prior = self._dyncfg_records.pop(plan.name, None)
+                self._dyncfg_records[plan.name] = self._record_ddl(
+                    sql, {"set": plan.name}
+                )
+                if prior is not None:
+                    self._catalog_append(prior, -1)
             return ExecuteResult("ok")
         if isinstance(plan, ShowVarPlan):
             cur = COMPUTE_CONFIGS.current()
@@ -442,6 +566,8 @@ class Coordinator:
                     + self._donation_analysis_text()
                     + "\n"
                     + self._sharding_analysis_text()
+                    + "\n"
+                    + self._recovery_analysis_text()
                 )
             return ExecuteResult(
                 "text", text=text, columns=("explain",)
@@ -953,12 +1079,12 @@ class Coordinator:
         if unlocked:
             with self._unlocked():
                 rows, _ = self.controller.peek(
-                    name, as_of=as_of_sel, timeout=PEEK_TIMEOUT,
+                    name, as_of=as_of_sel, timeout=_peek_timeout(),
                     exact=exact,
                 )
         else:
             rows, _ = self.controller.peek(
-                name, as_of=as_of_sel, timeout=PEEK_TIMEOUT,
+                name, as_of=as_of_sel, timeout=_peek_timeout(),
                 exact=exact,
             )
         return rows
@@ -1522,7 +1648,7 @@ class Coordinator:
                 exact = False
             with self._unlocked():
                 rows, _ = self.controller.peek(
-                    df, as_of=as_of, timeout=PEEK_TIMEOUT, exact=exact
+                    df, as_of=as_of, timeout=_peek_timeout(), exact=exact
                 )
             return ExecuteResult(
                 "rows",
@@ -1617,7 +1743,7 @@ class Coordinator:
                 dec.kind == "scan",
                 probe,
                 as_of,
-                timeout=PEEK_TIMEOUT,
+                timeout=_peek_timeout(),
             )
         return rows
 
@@ -1665,7 +1791,7 @@ class Coordinator:
         # dance would re-acquire just to release again): everything
         # the read needs was resolved above.
         rows, _ = self.controller.peek_lookup(
-            df, cols, False, probe, as_of, timeout=PEEK_TIMEOUT
+            df, cols, False, probe, as_of, timeout=_peek_timeout()
         )
         return _finish(rows)
 

@@ -72,6 +72,15 @@ INTROSPECTION_SCHEMAS: dict[str, Schema] = {
             Column("blame", S),
         ]
     ),
+    "mz_recovery": Schema(
+        [
+            Column("scope", S),
+            Column("object", S),
+            Column("replica", S),
+            Column("metric", S),
+            Column("value", F),
+        ]
+    ),
     "mz_metrics": Schema(
         [Column("metric", S), Column("value", F)]
     ),
@@ -223,6 +232,36 @@ def snapshot(coord, name: str) -> list[tuple]:
                         _enc(blame),
                     )
                 )
+        return rows
+    if name == "mz_recovery":
+        # Crash-recovery accounting (ISSUE 10): coordinator boot
+        # replay counts, per-replica session/fence counters, and the
+        # per-dataflow install/rebuild/reconcile counts replicas
+        # piggyback on Frontiers. `rebuilds == 0` for a
+        # fingerprint-unchanged dataflow across a restart IS the
+        # counted reconciliation invariant.
+        rows = []
+        for metric, value in sorted(coord.recovery.items()):
+            rows.append(
+                (_enc("coordinator"), _enc(""), _enc(""),
+                 _enc(metric), float(value))
+            )
+        snap = coord.controller.recovery_snapshot()
+        for rep, st in sorted(snap["replicas"].items()):
+            for metric in ("sessions", "reconnects", "fenced",
+                           "connected"):
+                rows.append(
+                    (_enc("replica"), _enc(""), _enc(rep),
+                     _enc(metric), float(st[metric]))
+                )
+        for df, per in sorted(snap["dataflows"].items()):
+            for rep, v in sorted(per.items()):
+                for metric in ("installs", "rebuilds", "reconciles",
+                               "hydrate_ms"):
+                    rows.append(
+                        (_enc("dataflow"), _enc(df), _enc(rep),
+                         _enc(metric), float(v.get(metric, 0)))
+                    )
         return rows
     if name == "mz_metrics":
         from ..utils.metrics import REGISTRY

@@ -48,6 +48,16 @@ class ServerBusy(RuntimeError):
     an unbounded backlog."""
 
 
+class PeekTimedOut(ServerBusy):
+    """A peek (or batched gather) wait exhausted its budget
+    (``retry_policy_peek``). A ServerBusy subclass on purpose: the
+    client should RETRY, so the front ends shed it exactly like an
+    admission-control rejection (SQLSTATE 53400 / HTTP 503), never a
+    generic internal error — and the sequencing lock is released
+    around every such wait, so a timed-out statement can never poison
+    later ones (ISSUE 10 satellite)."""
+
+
 # Span tiers for match ranges: the gather program reserves S candidate
 # slots per probe and retries at the next tier when a probe matches
 # more (duplicates / wide groups).

@@ -38,6 +38,27 @@ class TransportError(RuntimeError):
     pass
 
 
+def hard_close(sock: socket.socket) -> None:
+    """Close a socket that ANOTHER thread may be blocked reading.
+
+    A bare ``close()`` is deferred by CPython while a sibling thread
+    sits in ``recv`` on the same socket object (``_io_refs``): the fd
+    never actually closes, the peer never sees FIN, and the blocked
+    reader never wakes — a fenced replica session then leaves its old
+    controller hanging "connected" forever (found by the ISSUE 10
+    chaos harness: frame-kill storms wedged exactly here).
+    ``shutdown(SHUT_RDWR)`` takes effect immediately regardless of
+    concurrent readers, waking them with EOF; the close then lands."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
 def send_frame(sock: socket.socket, payload: bytes) -> None:
     header = FRAME_MAGIC + struct.pack(
         "<II", len(payload), native.crc32c(payload)
@@ -193,6 +214,7 @@ def frontiers(
     replica_id: str,
     donation: dict | None = None,
     sharding: dict | None = None,
+    recovery: dict | None = None,
 ) -> dict:
     """Replica -> controller frontier report. ``span_epochs`` carries
     each dataflow's monotone COMMITTED span counter (ISSUE 7: the
@@ -205,7 +227,11 @@ def frontiers(
     change so steady state pays nothing. ``sharding`` piggybacks the
     shard-spec prover's report (ISSUE 9: SPMD-safety verdict, resolved
     ingest mode, communication census) the same way — the EXPLAIN
-    ANALYSIS ``sharding:`` and mz_sharding surface."""
+    ANALYSIS ``sharding:`` and mz_sharding surface. ``recovery``
+    piggybacks each dataflow's install/rebuild/reconcile counters
+    (ISSUE 10) whenever they change — the mz_recovery surface that
+    makes reconciliation a counted invariant (rebuilds == 0 across a
+    controller restart with unchanged fingerprints)."""
     msg = {
         "kind": "Frontiers",
         "uppers": uppers,
@@ -217,4 +243,6 @@ def frontiers(
         msg["donation"] = donation
     if sharding:
         msg["sharding"] = sharding
+    if recovery:
+        msg["recovery"] = recovery
     return msg

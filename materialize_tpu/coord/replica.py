@@ -114,6 +114,13 @@ class ReplicaWorker:
         self.dataflows: dict[str, _Installed] = {}
         self.pending_peeks: list[dict] = []
         self.config: dict = {}
+        # Recovery accounting (ISSUE 10): per-dataflow install /
+        # rebuild / reconcile counts + last hydration time, piggybacked
+        # on Frontiers whenever they change. A fingerprint-unchanged
+        # dataflow surviving a controller restart must show
+        # rebuilds == 0 — reconciliation as a counted invariant.
+        self._recovery: dict[str, dict] = {}
+        self._recovery_dirty: set = set()
         self._stop = threading.Event()
         # A rebalance initiated ELSEWHERE in this process (e.g. the
         # coordinator replanning after a planning-time exhaustion)
@@ -207,7 +214,11 @@ class ReplicaWorker:
                 # reconnects and replays history (rehydration).
                 pass
             finally:
-                conn.close()
+                # hard_close, not close: the session's reader thread
+                # may still be blocked in recv on this socket, and a
+                # deferred close would leave the fenced controller
+                # hanging on a half-dead link forever (chaos-found).
+                ctp.hard_close(conn)
 
     def stop(self) -> None:
         self._stop.set()
@@ -315,13 +326,28 @@ class ReplicaWorker:
             desc.expr, make_mesh(self.workers), name=desc.name
         )
 
+    def _count_recovery(self, name: str, key: str) -> dict:
+        rec = self._recovery.setdefault(
+            name,
+            {"installs": 0, "rebuilds": 0, "reconciles": 0,
+             "hydrate_ms": 0.0},
+        )
+        if key:
+            rec[key] = rec.get(key, 0) + 1
+        self._recovery_dirty.add(name)
+        return rec
+
     def _build(self, desc: DataflowDescription) -> _Installed:
         """Build (or rebuild) a dataflow. Hydration can race with an
         active-active sibling writing the same sink (SinkConflict) or
         with its compaction moving the as_of (ValueError): both are
-        transient — retry against the fresh durable state."""
-        last: Exception | None = None
-        for _ in range(5):
+        transient — retry against the fresh durable state on the
+        unified ``retry_policy_hydration`` backoff."""
+        from ..utils.retry import policy as _retry_policy
+
+        t0 = _time.monotonic()
+        stream = _retry_policy("hydration").stream()
+        while True:
             # Render BEFORE subscribing index sources: a render failure
             # must not leak subscribers onto publishers (each publisher
             # step would copy its delta to the orphan forever).
@@ -343,7 +369,7 @@ class ReplicaWorker:
                     index_sources[name] = IndexSource(
                         pub.view, schema
                     )
-                return _Installed(
+                inst = _Installed(
                     desc,
                     MaintainedView(
                         self.client,
@@ -355,19 +381,22 @@ class ReplicaWorker:
                         as_of=getattr(desc, "as_of", None),
                     ),
                 )
+                self._count_recovery(desc.name, "")["hydrate_ms"] = (
+                    (_time.monotonic() - t0) * 1000.0
+                )
+                return inst
             except (SinkConflict, Fenced, ValueError) as e:
                 # Fenced: an active-active sibling re-registered the sink
                 # writer mid-hydration (epoch ping-pong) — rebuild picks
                 # up the durable state it wrote.
                 for src in index_sources.values():
                     src.reader.expire()  # unsubscribe the failed attempt
-                last = e
-                _time.sleep(0.01)
+                if not stream.sleep():
+                    raise
             except BaseException:
                 for src in index_sources.values():
                     src.reader.expire()
                 raise
-        raise last
 
     def _drain_pending_remaps(self, conn) -> bool:
         """Apply rebalances initiated elsewhere in this process: remap
@@ -425,6 +454,7 @@ class ReplicaWorker:
         for name, inst in list(self.dataflows.items()):
             try:
                 self.dataflows[name] = self._build(inst.desc)
+                self._count_recovery(name, "rebuilds")
             except Exception as e:
                 failed.append(name)
                 self.dataflows.pop(name, None)
@@ -488,12 +518,14 @@ class ReplicaWorker:
             inst.view.expire()
         desc = new_desc if new_desc is not None else inst.desc
         self.dataflows[name] = self._build(desc)
+        self._count_recovery(name, "rebuilds")
         for dn in deps:
             dinst = self.dataflows.get(dn)
             if dinst is None:
                 continue
             dinst.view.expire()
             self.dataflows[dn] = self._build(dinst.desc)
+            self._count_recovery(dn, "rebuilds")
 
     def _send_installed(self, conn, name: str, error) -> None:
         """Install ack: the DDL response path waits on these so a bad
@@ -539,6 +571,12 @@ class ReplicaWorker:
                 and existing.fingerprint == desc.fingerprint()
             ):
                 existing.reported_upper = -1  # re-report frontier
+                # The counted reconciliation invariant (ISSUE 10): a
+                # kept dataflow increments `reconciles` and NOT
+                # `rebuilds` — a restarted controller whose replayed
+                # descriptions fingerprint-match must leave
+                # rebuilds == 0 (asserted in tests via mz_recovery).
+                self._count_recovery(desc.name, "reconciles")
                 self._send_installed(conn, desc.name, None)
                 return  # reconciliation: unchanged, keep running
             try:
@@ -549,6 +587,7 @@ class ReplicaWorker:
                     self._rebuild_cascade(desc.name, new_desc=desc)
                 else:
                     self.dataflows[desc.name] = self._build(desc)
+                    self._count_recovery(desc.name, "installs")
             except DictExhausted:
                 # Dense string insertions (e.g. a generative function's
                 # table over a polluted dictionary) ran a label gap dry.
@@ -584,6 +623,9 @@ class ReplicaWorker:
                             self.dataflows[desc2.name] = self._build(
                                 desc2
                             )
+                            self._count_recovery(
+                                desc2.name, "installs"
+                            )
                         err = None
                         break
                     except DictExhausted as e:
@@ -615,6 +657,8 @@ class ReplicaWorker:
                 self._send_installed(conn, desc.name, None)
         elif kind == "DropDataflow":
             inst = self.dataflows.pop(cmd["name"], None)
+            self._recovery.pop(cmd["name"], None)
+            self._recovery_dirty.discard(cmd["name"])
             if inst is not None:
                 inst.view.expire()
         elif kind == "Peek":
@@ -917,12 +961,23 @@ class ReplicaWorker:
                 if info is not None:
                     sharding[name] = info
                 inst.view._sharding_dirty = False
-        if changed or donation or sharding:
+        # Recovery counters (ISSUE 10) ride the frontier report the
+        # same way: only when they changed (install, rebuild,
+        # reconciliation) — steady state ships nothing extra.
+        recovery = {}
+        if self._recovery_dirty:
+            dirty, self._recovery_dirty = self._recovery_dirty, set()
+            for name in dirty:
+                rec = self._recovery.get(name)
+                if rec is not None and name in self.dataflows:
+                    recovery[name] = dict(rec)
+        if changed or donation or sharding or recovery:
             ctp.send_msg(
                 conn,
                 ctp.frontiers(
                     changed, records, epochs, self.replica_id,
                     donation=donation, sharding=sharding,
+                    recovery=recovery,
                 ),
             )
             return True

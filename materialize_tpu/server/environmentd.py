@@ -114,18 +114,71 @@ class Environment:
             self.coord.add_replica(rid, ("127.0.0.1", port))
         self.pg = PgServer(self.coord, port=pg_port).start()
         self.http = HttpServer(self.coord, port=http_port).start()
+        self._down = False
 
-    def shutdown(self) -> None:
+    # -- restart recovery (ISSUE 10) ----------------------------------------
+    def recovery_report(self) -> dict:
+        """What this boot recovered: the coordinator's catalog replay
+        counts and the controller's replica/dataflow recovery view
+        (the programmatic face of `mz_recovery`)."""
+        report = {"coordinator": dict(self.coord.recovery)}
+        report.update(self.coord.controller.recovery_snapshot())
+        return report
+
+    def await_recovery(self, timeout: float = 120.0) -> dict:
+        """Block until every durable dataflow (MV/index) the replayed
+        catalog re-registered is installed on some replica, then
+        return the recovery report — the --recover boot path's proof
+        obligation: the catalog came back AND the dataflows re-rendered
+        and re-hydrated (from input-shard snapshots at the persisted
+        as_of; storage/persist/operators.py)."""
+        import time as _t
+
+        deadline = _t.monotonic() + timeout
+        for name in sorted(set(self.coord.peekable.values())):
+            self.coord.controller.wait_installed(
+                name, timeout=max(deadline - _t.monotonic(), 0.1)
+            )
+        return self.recovery_report()
+
+    def shutdown(self) -> dict:
+        """Stop listeners, coordinator, and replicas. Replica exits
+        escalate terminate -> kill when the graceful budget
+        (retry_policy_shutdown) expires — a wedged replica must never
+        hang shutdown forever — and the exit report says exactly what
+        happened to each process (ISSUE 10 satellite)."""
+        report: dict = {"replicas": [], "escalations": 0}
+        if self._down:
+            return report
+        self._down = True
         self.pg.stop()
         self.http.stop()
         self.coord.shutdown()
+        from ..utils.retry import policy as _retry_policy
+
+        budget = _retry_policy("shutdown").budget or 5.0
         for p in self.procs:
             p.terminate()
+        deadline = _time.monotonic() + budget
         for p in self.procs:
+            entry = {"pid": p.pid, "escalated": False}
             try:
-                p.wait(timeout=5)
+                entry["returncode"] = p.wait(
+                    timeout=max(deadline - _time.monotonic(), 0.1)
+                )
             except subprocess.TimeoutExpired:
+                # Escalate: SIGKILL, then a short bounded reap. A
+                # process that survives SIGKILL (unkillable D-state)
+                # is reported, not waited on forever.
+                entry["escalated"] = True
+                report["escalations"] += 1
                 p.kill()
+                try:
+                    entry["returncode"] = p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    entry["returncode"] = None
+            report["replicas"].append(entry)
+        return report
 
 
 def main() -> None:
@@ -149,6 +202,12 @@ def main() -> None:
         "--tick-interval", type=float, default=0.05,
         help="load-generator tick seconds",
     )
+    ap.add_argument(
+        "--recover", action="store_true",
+        help="restart-recovery boot: replay the durable catalog, "
+        "re-render every dataflow, wait for replicas to re-hydrate "
+        "from persist, and print the recovery report before serving",
+    )
     args = ap.parse_args()
     env = Environment(
         args.data_dir,
@@ -159,6 +218,12 @@ def main() -> None:
         tick_interval=args.tick_interval,
     )
     atexit.register(env.shutdown)
+    if args.recover:
+        import json as _json
+
+        report = env.await_recovery()
+        print("recovery: " + _json.dumps(report, sort_keys=True),
+              flush=True)
     print(
         f"materialize_tpu listening: pgwire=127.0.0.1:{env.pg.port} "
         f"http=127.0.0.1:{env.http.port} data={args.data_dir}",

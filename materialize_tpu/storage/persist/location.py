@@ -121,18 +121,25 @@ class ExternalDurabilityError(RuntimeError):
     """Injected / environmental durability-layer failure (retryable)."""
 
 
-def retry_external(f, attempts: int = 8, base_sleep: float = 0.01):
-    """Retry transient durability-layer failures with exponential backoff
-    (the reference's ore::retry discipline)."""
-    import time as _time
+def retry_external(
+    f, attempts: int | None = None, base_sleep: float | None = None
+):
+    """Retry transient durability-layer failures with jittered
+    exponential backoff (the reference's ore::retry discipline). The
+    shape comes from the unified ``retry_policy_durability`` dyncfg
+    (utils/retry.py); explicit ``attempts``/``base_sleep`` arguments
+    pin a local policy instead (tests)."""
+    from ...utils.retry import RetryPolicy, policy
 
-    for i in range(attempts):
-        try:
-            return f()
-        except ExternalDurabilityError:
-            if i + 1 >= attempts:
-                raise
-            _time.sleep(base_sleep * (2**i))
+    if attempts is not None or base_sleep is not None:
+        pol = RetryPolicy(
+            base=base_sleep if base_sleep is not None else 0.01,
+            attempts=attempts if attempts is not None else 8,
+            jitter=0.0,
+        )
+    else:
+        pol = policy("durability")
+    return pol.retry(f, retryable=(ExternalDurabilityError,))
 
 
 class UnreliableBlob(Blob):

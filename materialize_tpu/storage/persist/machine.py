@@ -273,9 +273,12 @@ class Machine:
         if n == 0:
             return "", 0, old_keys
         merged_key = f"{self.shard}/compact-{st.seqno}-{st.upper}"
-        self.blob.set(
-            merged_key, encode_part(schema, cols, nulls, time, diff)
-        )
+        # Retried like every durability-layer write (ISSUE 10: the
+        # chaos storms run compaction under UnreliableBlob, and an
+        # injected transient failure must not abort a compaction the
+        # part reads already survived).
+        data = encode_part(schema, cols, nulls, time, diff)
+        retry_external(lambda: self.blob.set(merged_key, data))
         return merged_key, n, old_keys
 
     def gc_consensus(self, keep_last: int = 1) -> None:

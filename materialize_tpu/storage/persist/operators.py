@@ -8,7 +8,13 @@ micro-batch step per chunk, and compare-and-appends the output delta to
 the view's shard. Resume is the reference's model exactly (SURVEY.md §5
 checkpoint/resume): NO operator-state checkpoint — on restart the
 dataflow re-renders and re-hydrates from input-shard snapshots at the
-output shard's upper.
+output shard's upper (``hydrate()`` below; sink-less indexes re-hydrate
+from the inputs' latest readable time). Since ISSUE 10 this path is the
+PROVEN recovery spine, not just the documented one: ``environmentd
+--recover`` replays the durable catalog through it, the chaos harness
+(testing/chaos.py) SIGKILLs processes mid-span and checks exact
+oracles, and reconciliation is a counted invariant (``mz_recovery``
+rebuilds == 0 for fingerprint-unchanged dataflows).
 """
 
 from __future__ import annotations

@@ -1,0 +1,677 @@
+"""Chaos harness: seeded fault injection over the crash-recovery spine.
+
+ISSUE 10's attack half. The recovery machinery (durable catalog +
+restart replay, replica reconnection + nonce fencing, persist
+compare-and-append) is only production-credible if it survives faults
+injected ON PURPOSE, with exact oracles — not "it usually comes back".
+This module composes three fault injectors:
+
+- **UnreliableBlob** (storage/persist/location.py): a deterministic
+  fraction of blob operations fail; every durable path must retry
+  through ``retry_policy_durability`` and an acked write must never
+  depend on a failed operation.
+- **ChaosProxy**: a TCP proxy between controller and replica that
+  drops connections, delays frames, and partitions the link on a
+  seeded schedule — the CTP fault injector (the reference tests the
+  same surface with toxiproxy-style partitions).
+- **process kills**: subprocess replicas are SIGKILLed mid-span /
+  mid-ingest / mid-DDL and respawned on the same port; the controller
+  reconnects, replays history, and the replica re-hydrates from
+  persist.
+
+The driver runs a retraction-storm + late-data workload against a
+host-side oracle and checks EXACT invariants at the end (after
+healing):
+
+1. the maintained view's peeked result == the oracle multiset
+   (zero lost acknowledged writes AND zero double-applied deltas — a
+   multiset can only match exactly if neither happened);
+2. the durable sink shard holds the same multiset (what a fresh
+   replica would resume from);
+3. ``rebuilds == 0`` for every dataflow whose description never
+   changed (reconciliation as a counted invariant, via the replica
+   recovery counters surfaced in mz_recovery).
+
+Faults are scheduled by a seeded RNG so a failing run replays.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import socket
+import subprocess
+import sys
+import threading
+import time as _time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# CTP fault injection: the chaos proxy
+# ---------------------------------------------------------------------------
+
+
+class ChaosProxy:
+    """TCP proxy injecting control-plane faults between a controller
+    and one replica. Connections accepted on ``port`` forward to
+    ``target``; the seeded schedule decides which forwarded chunks die
+    (connection reset mid-frame — the CRC/partial-frame path) and how
+    long frames are delayed. ``partition()`` severs the link entirely
+    until ``heal()``."""
+
+    def __init__(
+        self,
+        target: tuple[str, int],
+        seed: int = 0,
+        kill_every: int = 0,
+        delay_ms: float = 0.0,
+    ):
+        self.target = target
+        self.kill_every = kill_every
+        self.delay_ms = delay_ms
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._partitioned = threading.Event()
+        self._conns: list = []
+        self._conns_lock = threading.Lock()
+        self.stats = {"accepted": 0, "chunks": 0, "killed": 0}
+        self._listener = socket.socket()
+        self._listener.setsockopt(
+            socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+        )
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(8)
+        self._listener.settimeout(0.2)
+        self.port = self._listener.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    @property
+    def addr(self) -> tuple:
+        return ("127.0.0.1", self.port)
+
+    # -- fault controls -----------------------------------------------------
+    def partition(self) -> None:
+        """Sever the link: refuse new connections and kill live ones
+        (both directions — the controller sees a dead socket, the
+        replica sees its session drop)."""
+        self._partitioned.set()
+        self.kill_connections()
+
+    def heal(self) -> None:
+        self._partitioned.clear()
+
+    def kill_connections(self) -> None:
+        from ..coord.protocol import hard_close
+
+        with self._conns_lock:
+            doomed, self._conns = self._conns, []
+        for s in doomed:
+            # shutdown-then-close: pump threads blocked in recv on
+            # these sockets must wake with EOF (a bare close defers
+            # while they hold the socket — the exact hazard the proxy
+            # exists to inject, not to suffer).
+            hard_close(s)
+        if doomed:
+            self.stats["killed"] += 1
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.kill_connections()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    # -- plumbing -----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if self._partitioned.is_set():
+                client.close()
+                continue
+            try:
+                upstream = socket.create_connection(
+                    self.target, timeout=5.0
+                )
+            except OSError:
+                client.close()
+                continue
+            self.stats["accepted"] += 1
+            with self._conns_lock:
+                self._conns.extend((client, upstream))
+            for src, dst in ((client, upstream), (upstream, client)):
+                threading.Thread(
+                    target=self._pump, args=(src, dst), daemon=True
+                ).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        pair = (src, dst)
+        try:
+            while not self._stop.is_set():
+                data = src.recv(65536)
+                if not data:
+                    break
+                self.stats["chunks"] += 1
+                if self.delay_ms:
+                    _time.sleep(self.delay_ms / 1000.0)
+                if self.kill_every:
+                    with self._rng_lock:
+                        die = (
+                            self._rng.randrange(self.kill_every) == 0
+                        )
+                    if die:
+                        # Mid-frame reset: the receiver sees a torn
+                        # frame, both sides reconnect.
+                        break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            from ..coord.protocol import hard_close
+
+            for s in pair:
+                hard_close(s)
+
+
+# ---------------------------------------------------------------------------
+# replica process management
+# ---------------------------------------------------------------------------
+
+
+def subprocess_available() -> bool:
+    """Whether this host can spawn replica subprocesses (the chaos
+    lane skips cleanly where it cannot — sandboxes without fork)."""
+    try:
+        p = subprocess.Popen(
+            [sys.executable, "-c", "pass"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        p.wait(timeout=30)
+        return p.returncode == 0
+    except Exception:
+        return False
+
+
+class ReplicaProcess:
+    """One subprocess replica (clusterd) that can be SIGKILLed and
+    respawned on the same port."""
+
+    def __init__(self, blob: str, consensus: str, port: int,
+                 rid: str = "r0"):
+        self.blob = blob
+        self.consensus = consensus
+        self.port = port
+        self.rid = rid
+        self.proc: subprocess.Popen | None = None
+        self.kills = 0
+        self.spawn()
+
+    def spawn(self) -> None:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "materialize_tpu.coord.replica",
+                "--port", str(self.port),
+                "--blob", self.blob,
+                "--consensus", self.consensus,
+                "--replica-id", self.rid,
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+
+    def sigkill(self) -> None:
+        if self.proc is not None:
+            self.proc.kill()
+            self.proc.wait()
+            self.kills += 1
+
+    def sigkill_and_respawn(self) -> None:
+        self.sigkill()
+        self.spawn()
+
+    def stop(self) -> None:
+        if self.proc is not None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+
+# ---------------------------------------------------------------------------
+# the storm driver
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ChaosReport:
+    ops: int = 0
+    inserts: int = 0
+    retractions: int = 0
+    late: int = 0
+    acked_times: int = 0
+    replica_kills: int = 0
+    partitions: int = 0
+    conn_kills: int = 0
+    blob_fail_every: int = 0
+    failures: list = field(default_factory=list)
+    oracle: dict = field(default_factory=dict)
+    result: dict = field(default_factory=dict)
+    sink: dict = field(default_factory=dict)
+    recovery: dict = field(default_factory=dict)
+    elapsed_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+def _mk_kv_schema():
+    from ..repr.schema import Column, ColumnType, Schema
+
+    return Schema(
+        [Column("k", ColumnType.INT64), Column("v", ColumnType.INT64)]
+    )
+
+
+def _sum_by_k(schema):
+    from ..expr import relation as mir
+    from ..expr.relation import AggregateExpr, AggregateFunc
+    from ..expr.scalar import col
+
+    return mir.Get("kv", schema).reduce(
+        (0,), (AggregateExpr(AggregateFunc.SUM_INT, col(1)),)
+    )
+
+
+class ChaosDriver:
+    """A controller + one replica (thread or subprocess) joined
+    through a ChaosProxy, over optionally-unreliable blob storage.
+    ``run_storm`` feeds a seeded retraction storm with late data into
+    the ``kv`` shard while injecting scheduled faults, then verifies
+    the exact invariants."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        seed: int = 0,
+        subprocess_replica: bool = False,
+        blob_fail_every: int = 0,
+        proxy_kill_every: int = 0,
+    ):
+        from ..coord.controller import ComputeController
+        from ..coord.protocol import DataflowDescription, PersistLocation
+        from ..storage.persist import (
+            FileBlob,
+            PersistClient,
+            SqliteConsensus,
+            UnreliableBlob,
+        )
+
+        os.makedirs(data_dir, exist_ok=True)
+        self.rng = random.Random(seed)
+        self.blob_path = os.path.join(data_dir, "blob")
+        self.cons_path = os.path.join(data_dir, "consensus.db")
+        blob = FileBlob(self.blob_path)
+        if blob_fail_every:
+            blob = UnreliableBlob(blob, fail_every=blob_fail_every)
+        self.persist = PersistClient(
+            blob, SqliteConsensus(self.cons_path)
+        )
+        self.schema = _mk_kv_schema()
+        self.writer = self.persist.open_writer("kv", self.schema)
+        self.report = ChaosReport(blob_fail_every=blob_fail_every)
+
+        # Replica: subprocess (SIGKILL-able) or in-process thread.
+        port = _free_port()
+        self.replica_proc: ReplicaProcess | None = None
+        self._replica_worker = None
+        if subprocess_replica:
+            self.replica_proc = ReplicaProcess(
+                self.blob_path, self.cons_path, port
+            )
+        else:
+            from ..coord.replica import serve_forever
+
+            ready = threading.Event()
+            threading.Thread(
+                target=serve_forever,
+                args=(
+                    port,
+                    PersistLocation(self.blob_path, self.cons_path),
+                    "r0",
+                    ready,
+                ),
+                daemon=True,
+            ).start()
+            ready.wait(10)
+
+        self.proxy = ChaosProxy(
+            ("127.0.0.1", port),
+            seed=seed ^ 0x5EED,
+            kill_every=proxy_kill_every,
+        )
+        self.ctl = ComputeController()
+        self.ctl.add_replica("r0", self.proxy.addr)
+        self.desc = DataflowDescription(
+            name="mv_sums",
+            expr=_sum_by_k(self.schema),
+            source_imports={"kv": ("kv", self.schema)},
+            sink_shard="mv_sums_out",
+        )
+        self.ctl.create_dataflow(self.desc)
+        # Oracle: the net multiset of (k, v) rows ever acked. The MV
+        # result oracle derives from it (sum v per k).
+        self.oracle: dict = {}
+
+    # -- workload -----------------------------------------------------------
+    def _feed(self, t: int, ups: list) -> None:
+        """One acked write: compare_and_append returning IS the ack —
+        once it returns, every later invariant treats these rows as
+        durable truth."""
+        k = np.array([p[0] for p in ups], np.int64)
+        v = np.array([p[1] for p in ups], np.int64)
+        d = np.array([p[2] for p in ups], np.int64)
+        self.writer.compare_and_append(
+            [k, v], [None, None],
+            np.full(len(ups), t, np.uint64), d, t, t + 1,
+        )
+        for key, val, diff in ups:
+            self.oracle[(key, val)] = (
+                self.oracle.get((key, val), 0) + diff
+            )
+            if self.oracle[(key, val)] == 0:
+                del self.oracle[(key, val)]
+        self.report.acked_times += 1
+        self.report.inserts += sum(1 for u in ups if u[2] > 0)
+        self.report.retractions += sum(1 for u in ups if u[2] < 0)
+
+    def run_storm(
+        self,
+        ticks: int = 60,
+        keys: int = 8,
+        fault_plan: dict | None = None,
+    ) -> ChaosReport:
+        """The retraction-storm + late-data workload. Per tick: a
+        burst of inserts, retractions of rows inserted earlier
+        (sampled from the live oracle — every retraction is valid),
+        and LATE re-inserts of long-retracted rows. ``fault_plan``
+        maps tick -> a list of fault actions:
+        ``"kill_conns"``, ``("partition", n_ticks)``,
+        ``"kill_replica"`` (subprocess mode only), ``"ddl"``
+        (install + drop a second dataflow mid-storm)."""
+        t0 = _time.monotonic()
+        fault_plan = fault_plan or {}
+        heal_at = -1
+        live_retracted: list = []
+        for t in range(ticks):
+            for action in _actions_at(fault_plan, t):
+                if action == "kill_conns":
+                    self.proxy.kill_connections()
+                    self.report.conn_kills += 1
+                elif (
+                    isinstance(action, tuple)
+                    and action[0] == "partition"
+                ):
+                    self.proxy.partition()
+                    self.report.partitions += 1
+                    heal_at = t + action[1]
+                elif action == "kill_replica":
+                    if self.replica_proc is not None:
+                        # Pace the kill so it lands MID-SPAN: wait
+                        # (bounded) until the replica has caught up to
+                        # the storm — killing a replica that never
+                        # even connected proves nothing about span
+                        # recovery. The wait is best-effort; a replica
+                        # that cannot catch up gets killed anyway.
+                        deadline = _time.monotonic() + 240.0
+                        while (
+                            self.ctl.any_frontier("mv_sums") < t
+                            and _time.monotonic() < deadline
+                        ):
+                            _time.sleep(0.02)
+                        self.replica_proc.sigkill_and_respawn()
+                        self.report.replica_kills += 1
+                elif action == "ddl":
+                    # Mid-storm DDL: a second dataflow installs (and
+                    # must come back after any concurrent fault).
+                    self._mid_storm_ddl(t)
+            if heal_at == t:
+                self.proxy.heal()
+            ups = []
+            # Insert burst.
+            for _ in range(self.rng.randrange(1, 4)):
+                k = self.rng.randrange(keys)
+                v = self.rng.randrange(100)
+                ups.append((k, v, 1))
+            # Retraction storm: retract currently-live rows.
+            live = list(self.oracle.items())
+            if live and self.rng.random() < 0.7:
+                (rk, rv), _n = self.rng.choice(live)
+                ups.append((rk, rv, -1))
+                live_retracted.append((rk, rv))
+            # Late data: re-insert a row retracted long ago.
+            if live_retracted and self.rng.random() < 0.3:
+                lk, lv = live_retracted.pop(0)
+                ups.append((lk, lv, 1))
+                self.report.late += 1
+            self._feed(t, ups)
+        if heal_at >= ticks:
+            # heal_at == ticks included: the in-loop heal only fires
+            # for t < ticks, so a partition whose duration lands
+            # exactly on the last tick must heal here or the link
+            # stays severed after the storm returns.
+            self.proxy.heal()
+        self.report.ops = ticks
+        self.report.elapsed_s = _time.monotonic() - t0
+        return self.report
+
+    def _mid_storm_ddl(self, t: int) -> None:
+        from ..coord.protocol import DataflowDescription
+
+        name = f"mv_ddl_{t}"
+        self.ctl.create_dataflow(
+            DataflowDescription(
+                name=name,
+                expr=_sum_by_k(self.schema),
+                source_imports={"kv": ("kv", self.schema)},
+                sink_shard=None,
+            )
+        )
+        self.ctl.drop_dataflow(name)
+
+    # -- verification -------------------------------------------------------
+    def expected_sums(self) -> dict:
+        """The MV oracle: SUM(v) per key over the net acked multiset
+        (oracle entries are always live rows — zero-count pairs are
+        deleted on retraction — so every key present has a group)."""
+        sums: dict = {}
+        for (k, v), n in self.oracle.items():
+            sums[k] = sums.get(k, 0) + v * n
+        return {(k, s): 1 for k, s in sums.items()}
+
+    def verify(self, timeout: float = 180.0) -> ChaosReport:
+        """Heal every fault, wait for the frontier, and check the
+        exact invariants. Appends human-readable failure descriptions
+        to the report instead of raising — the caller asserts
+        ``report.ok`` so a failed storm prints the whole picture."""
+        rep = self.report
+        self.proxy.heal()
+        # Stop injecting blob faults for the verification reads (the
+        # retry machinery was the thing under test during the storm).
+        blob = self.persist.blob
+        if hasattr(blob, "fail_every"):
+            blob.fail_every = 0
+        frontier = self.writer.upper
+        try:
+            deadline = _time.monotonic() + timeout
+            while self.ctl.any_frontier("mv_sums") < frontier:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"mv_sums frontier stuck at "
+                        f"{self.ctl.any_frontier('mv_sums')} < "
+                        f"{frontier}"
+                    )
+                _time.sleep(0.01)
+            rows, _ = self.ctl.peek(
+                "mv_sums", as_of=frontier - 1, timeout=timeout
+            )
+        except Exception as e:
+            rep.failures.append(f"verification peek failed: {e!r}")
+            rep.recovery = self.ctl.recovery_snapshot()
+            return rep
+        got: dict = {}
+        for r in rows:
+            got[r[:-2]] = got.get(r[:-2], 0) + r[-1]
+        got = {k: n for k, n in got.items() if n}
+        expect = self.expected_sums()
+        rep.oracle = expect
+        rep.result = got
+        if got != expect:
+            missing = {k: n for k, n in expect.items() if got.get(k) != n}
+            extra = {k: n for k, n in got.items() if expect.get(k) != n}
+            rep.failures.append(
+                "peeked result diverged from oracle (lost ack or "
+                f"double-applied delta): missing={missing} "
+                f"extra={extra}"
+            )
+        # The durable sink must hold the identical multiset: that is
+        # what any FUTURE replica resumes from.
+        try:
+            reader = self.persist.open_reader("mv_sums_out", "chaos-verify")
+            try:
+                _sch, cols, _nulls, _t2, diff = reader.snapshot(
+                    frontier - 1
+                )
+            finally:
+                reader.expire()
+            sink: dict = {}
+            for i in range(len(diff)):
+                key = tuple(int(c[i]) for c in cols)
+                sink[key] = sink.get(key, 0) + int(diff[i])
+            sink = {k: n for k, n in sink.items() if n}
+            rep.sink = sink
+            if sink != expect:
+                rep.failures.append(
+                    f"durable sink diverged from oracle: {sink} != "
+                    f"{expect}"
+                )
+        except Exception as e:
+            rep.failures.append(f"sink verification failed: {e!r}")
+        # Counted reconciliation: no description ever changed, so NO
+        # dataflow may report a rebuild — reconnects and kills must
+        # resolve through reconciliation (surviving replica) or fresh
+        # installs (respawned process), never silent rebuilds.
+        rep.recovery = self.ctl.recovery_snapshot()
+        for df, per in rep.recovery["dataflows"].items():
+            for r, v in per.items():
+                if int(v.get("rebuilds", 0)) != 0:
+                    rep.failures.append(
+                        f"dataflow {df!r} on {r} reports "
+                        f"{v['rebuilds']} rebuild(s); fingerprints "
+                        "never changed, so reconciliation should have "
+                        "kept it"
+                    )
+        return rep
+
+    def shutdown(self) -> None:
+        try:
+            self.ctl.shutdown()
+        except Exception:
+            pass
+        self.proxy.stop()
+        if self.replica_proc is not None:
+            self.replica_proc.stop()
+
+
+def _actions_at(plan: dict, t: int) -> list:
+    got = plan.get(t, [])
+    if not isinstance(got, list):
+        got = [got]
+    return got
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def seeded_fault_plan(
+    seed: int,
+    ticks: int,
+    conn_kills: int = 2,
+    partitions: int = 1,
+    replica_kills: int = 0,
+    ddls: int = 1,
+) -> dict:
+    """A deterministic fault schedule: fault ticks drawn without
+    replacement from the storm's middle third outward, so faults land
+    while state is nontrivial and the tail leaves room to recover."""
+    rng = random.Random(seed ^ 0xC4A05)
+    plan: dict = {}
+    lo, hi = max(1, ticks // 6), max(2, ticks - 2)
+    candidates = list(range(lo, hi))
+    rng.shuffle(candidates)
+
+    def take(action, n):
+        for _ in range(n):
+            if not candidates:
+                return
+            plan.setdefault(candidates.pop(), []).append(action)
+
+    take("kill_conns", conn_kills)
+    take(("partition", max(2, ticks // 10)), partitions)
+    take("kill_replica", replica_kills)
+    take("ddl", ddls)
+    return plan
+
+
+def run_chaos(
+    data_dir: str,
+    seed: int = 0,
+    ticks: int = 60,
+    subprocess_replica: bool = False,
+    blob_fail_every: int = 13,
+    proxy_kill_every: int = 0,
+    replica_kills: int = 0,
+    verify_timeout: float = 180.0,
+) -> ChaosReport:
+    """One seeded chaos run end to end: build the driver, run the
+    storm under the seeded fault plan, verify, tear down. The
+    ``check_plans.py --bench`` smoke gate and the pytest chaos lane
+    both enter here."""
+    driver = ChaosDriver(
+        data_dir,
+        seed=seed,
+        subprocess_replica=subprocess_replica,
+        blob_fail_every=blob_fail_every,
+        proxy_kill_every=proxy_kill_every,
+    )
+    try:
+        plan = seeded_fault_plan(
+            seed,
+            ticks,
+            replica_kills=replica_kills if subprocess_replica else 0,
+        )
+        driver.run_storm(ticks=ticks, fault_plan=plan)
+        return driver.verify(timeout=verify_timeout)
+    finally:
+        driver.shutdown()

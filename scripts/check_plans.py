@@ -304,7 +304,52 @@ def run_bench_mode(verbose: bool) -> int:
     rc |= run_donation_gates(gate)
     rc |= run_sharding_gates(gate, budgets)
     rc |= run_lockcheck_smoke(gate)
+    rc |= run_chaos_smoke(gate)
     return rc
+
+
+def run_chaos_smoke(gate) -> int:
+    """Chaos-lane smoke gate (ISSUE 10 satellite): ONE bounded,
+    seeded storm from the chaos harness (testing/chaos.py) — blob
+    faults + CTP connection kills + a partition against an in-process
+    replica, ~30 ticks — checking the exact-result, zero-lost-ack,
+    and zero-rebuild invariants. The full storms (subprocess replica
+    SIGKILLs, environmentd kill -9) stay in `pytest -m "chaos and
+    slow"`; this gate is the cheap always-on slice of the same
+    machinery. Skips cleanly where sockets/threads are unavailable."""
+    import shutil
+    import tempfile
+
+    from materialize_tpu.analysis import LintFinding
+    from materialize_tpu.testing.chaos import run_chaos
+
+    storm_dir = tempfile.mkdtemp(prefix="chaos-gate-")
+    try:
+        rep = run_chaos(
+            storm_dir,
+            seed=1,
+            ticks=25,
+            blob_fail_every=11,
+            proxy_kill_every=30,
+        )
+        findings = [
+            LintFinding("chaos-smoke", "invariant", f)
+            for f in rep.failures
+        ]
+    except OSError as e:
+        print(f"chaos-smoke: skipped (environment: {e!r})")
+        return 0
+    except Exception as e:
+        findings = [
+            LintFinding(
+                "chaos-smoke", "driver",
+                f"chaos smoke failed to run: {e!r}",
+            )
+        ]
+    finally:
+        shutil.rmtree(storm_dir, ignore_errors=True)
+    gate("chaos-smoke", None, findings, 0)
+    return 1 if findings else 0
 
 
 def sharded_bench_dataflows(mesh) -> dict:

@@ -73,6 +73,12 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 lane (-m 'not slow')"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: crash-consistency / fault-injection lane (ISSUE 10) — "
+        "seeded deterministic faults, exact oracles; run with "
+        "`pytest -m chaos` (full storms are additionally marked slow)",
+    )
     # The use-after-donate sanitizer is DEFAULT ON in the analysis
     # lane (ISSUE 8): donated dispatches record their killed carry
     # leaves and every guarded read site validates against the ledger.

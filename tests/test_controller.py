@@ -222,6 +222,77 @@ class TestReplicaController:
         worker._handle_command(None, ctp.create_dataflow(changed))
         assert worker.dataflows["mv1"] is not inst  # rebuilt
 
+    def test_stale_controller_cannot_install_after_takeover(
+        self, tmp_path
+    ):
+        """ISSUE 10 satellite: once a newer controller takes over, the
+        fenced (stale-nonce) session must not be able to install
+        dataflows — its link is torn down and commands on it go
+        nowhere; a stale RECONNECT gets HelloReject carrying the
+        fencing epoch (which the client uses to fast-forward)."""
+        loc = PersistLocation(
+            str(tmp_path / "blob"), str(tmp_path / "consensus.db")
+        )
+        worker = ReplicaWorker(location=loc)
+        lsock = socket.socket()
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(4)
+        port = lsock.getsockname()[1]
+        threading.Thread(
+            target=worker.serve, args=(lsock,), daemon=True
+        ).start()
+        s1 = socket.create_connection(("127.0.0.1", port))
+        ctp.send_msg(s1, ctp.hello(5))
+        assert ctp.recv_msg(s1)["kind"] == "HelloOk"
+        s2 = socket.create_connection(("127.0.0.1", port))
+        ctp.send_msg(s2, ctp.hello(9))  # takeover fences s1
+        assert ctp.recv_msg(s2)["kind"] == "HelloOk"
+        # The stale session is torn down.
+        s1.settimeout(10.0)
+        with pytest.raises((ctp.TransportError, OSError)):
+            while True:
+                ctp.recv_msg(s1)
+        # A command shoved down the stale link must never install.
+        try:
+            ctp.send_msg(s1, ctp.create_dataflow(_desc("stale_mv")))
+        except OSError:
+            pass
+        _time.sleep(0.5)
+        assert "stale_mv" not in worker.dataflows
+        # A stale reconnect is rejected WITH the fencing epoch.
+        s3 = socket.create_connection(("127.0.0.1", port))
+        ctp.send_msg(s3, ctp.hello(3))
+        rej = ctp.recv_msg(s3)
+        assert rej["kind"] == "HelloReject" and rej["epoch"] == 9
+        # The live controller still installs fine.
+        ctp.send_msg(s2, ctp.create_dataflow(_desc("live_mv")))
+        deadline = _time.monotonic() + 60
+        while "live_mv" not in worker.dataflows:
+            assert _time.monotonic() < deadline
+            _time.sleep(0.05)
+        for s in (s1, s2, s3):
+            s.close()
+        worker.stop()
+
+    def test_restarted_controller_refences_quickly(self, tmp_path):
+        """A restarted controller's nonce counter resets to 1; the
+        HelloReject fast-forward (ISSUE 10) must let it re-fence a
+        surviving replica in one reject round instead of probing one
+        nonce per backoff cycle."""
+        port, _ = _start_replica(tmp_path)
+        ctl1 = ComputeController()
+        ctl1.add_replica("r0", ("127.0.0.1", port))
+        assert ctl1.replicas["r0"].connected.wait(15)
+        ctl1.shutdown()
+        ctl2 = ComputeController()  # fresh process analog: nonce = 1
+        ctl2.add_replica("r0", ("127.0.0.1", port))
+        assert ctl2.replicas["r0"].connected.wait(15)
+        assert ctl2.replicas["r0"].fenced >= 1
+        snap = ctl2.recovery_snapshot()
+        assert snap["replicas"]["r0"]["connected"]
+        ctl2.shutdown()
+
     def test_nonce_fencing(self, tmp_path):
         """A controller with a stale nonce is rejected (split-brain
         prevention, protocol/command.rs:45-53)."""

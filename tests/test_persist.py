@@ -263,6 +263,113 @@ class TestFaultInjection:
         _sch, cols, _nulls, _time, diff = r.snapshot(5)
         assert int(diff.sum()) == 6
 
+    @pytest.mark.chaos
+    def test_acked_writes_survive_reload_under_faults(self):
+        """Property (ISSUE 10 satellite): across fault rates, every
+        ACKED compare_and_append — including retractions — is exactly
+        visible after a restart (fresh client over the same durable
+        state, faults off)."""
+        for fail_every in (2, 3, 5):
+            blob, cons = MemBlob(), MemConsensus()
+            c = PersistClient(
+                UnreliableBlob(blob, fail_every=fail_every), cons
+            )
+            w = c.open_writer("s1", KV)
+            acked: dict = {}
+            for t in range(20):
+                ups = [(t % 4, t, 1)]
+                if t >= 4:
+                    ups.append((t % 4, t - 4, -1))  # retraction storm
+                w.compare_and_append(*_updates(ups, t=t), t, t + 1)
+                for k, v, d in ups:
+                    acked[(k, v)] = acked.get((k, v), 0) + d
+            acked = {k: n for k, n in acked.items() if n}
+            c2 = PersistClient(blob, cons)  # "restart"
+            assert c2.machine("s1").reload().upper == 20
+            r = c2.open_reader("s1")
+            _sch, cols, _n, _t, diff = r.snapshot(19)
+            got: dict = {}
+            for i in range(len(diff)):
+                key = (int(cols[0][i]), int(cols[1][i]))
+                got[key] = got.get(key, 0) + int(diff[i])
+            got = {k: n for k, n in got.items() if n}
+            assert got == acked, (fail_every, got, acked)
+
+    @pytest.mark.chaos
+    def test_failed_write_invisible_after_reload(self):
+        """A write whose blob part can NEVER land must be fully
+        invisible: the upper does not advance, no dangling part is
+        referenced, a restart reads exactly the prior acked content,
+        and the writer continues cleanly once the fault lifts."""
+        from materialize_tpu.storage.persist import (
+            ExternalDurabilityError,
+        )
+
+        blob, cons = MemBlob(), MemConsensus()
+        ub = UnreliableBlob(blob, fail_every=0)
+        c = PersistClient(ub, cons)
+        w = c.open_writer("s1", KV)
+        w.compare_and_append(*_updates([(1, 10, 1)], t=0), 0, 1)
+        ub.fail_every = 1  # every blob op fails: retries must exhaust
+        with pytest.raises(ExternalDurabilityError):
+            w.compare_and_append(*_updates([(2, 20, 1)], t=1), 1, 2)
+        ub.fail_every = 0
+        c2 = PersistClient(blob, cons)
+        st = c2.machine("s1").reload()
+        assert st.upper == 1  # the failed write never acked
+        for b in st.batches:  # no dangling part references
+            for key in b.keys:
+                assert blob.get(key) is not None
+        r = c2.open_reader("s1")
+        _sch, cols, _n, _t, diff = r.snapshot(0)
+        rows = {
+            (int(cols[0][i]), int(cols[1][i])): int(diff[i])
+            for i in range(len(diff))
+        }
+        assert rows == {(1, 10): 1}
+        w2 = c2.open_writer("s1", KV)  # continues after the fault
+        w2.compare_and_append(*_updates([(2, 20, 1)], t=1), 1, 2)
+        assert w2.upper == 2
+
+    @pytest.mark.chaos
+    def test_compaction_under_faults_preserves_content(self):
+        """Compaction under injected blob faults (reads, the merged
+        write, the best-effort deletes) must preserve the exact
+        snapshot content — a leaked part is fine, lost data is not."""
+        blob, cons = MemBlob(), MemConsensus()
+        ub = UnreliableBlob(blob, fail_every=4)
+        c = PersistClient(ub, cons)
+        w = c.open_writer("s1", KV)
+        for t in range(12):
+            w.compare_and_append(
+                *_updates([(t % 3, t, 1)], t=t), t, t + 1
+            )
+        m = c.machine("s1")
+        c.open_reader("s1", "hold").downgrade_since(11)
+
+        def content():
+            r = c.open_reader("s1", "chk")
+            _sch, cols, _n, _t, diff = r.snapshot(11)
+            out: dict = {}
+            for i in range(len(diff)):
+                key = (int(cols[0][i]), int(cols[1][i]))
+                out[key] = out.get(key, 0) + int(diff[i])
+            return {k: n for k, n in out.items() if n}
+
+        before = content()
+        m.maybe_compact(max_batches=2)
+        assert len(m.reload().batches) <= 2
+        assert content() == before
+        ub.fail_every = 0
+        c2 = PersistClient(blob, cons)
+        r2 = c2.open_reader("s1")
+        _sch, cols, _n, _t, diff = r2.snapshot(11)
+        after: dict = {}
+        for i in range(len(diff)):
+            key = (int(cols[0][i]), int(cols[1][i]))
+            after[key] = after.get(key, 0) + int(diff[i])
+        assert {k: n for k, n in after.items() if n} == before
+
 
 def _q1ish_mir():
     """SUM(v) GROUP BY k over the kv source."""
