@@ -112,7 +112,6 @@ class Coordinator:
         self._webhooks: dict[str, WriteHandle] = {}
         self.sources: dict[str, GeneratorSource] = {}
         self.sinks: dict[str, object] = {}  # KafkaSink by name
-        self.subscriptions: dict[int, Subscription] = {}
         self._sub_seq = 0
         self.tick_interval = tick_interval
         # name -> installed dataflow name serving peeks for it
@@ -151,6 +150,12 @@ class Coordinator:
         from ..utils.lockcheck import tracked_rlock
 
         self._lock = tracked_rlock("coord.sequencing", sequencing=True)
+        # The push serving plane (ISSUE 11): SUBSCRIBE sessions fan
+        # out from shared sink-shard tails — one readback per span, N
+        # consumers (coord/subscribe.py).
+        from .subscribe import SubscribeHub
+
+        self.subscribe_hub = SubscribeHub(self)
         # Introspection relations (mz_internal analog): virtual items
         # resolved to snapshots at peek time (introspection.py).
         from .introspection import INTROSPECTION_SCHEMAS
@@ -568,6 +573,8 @@ class Coordinator:
                     + self._sharding_analysis_text()
                     + "\n"
                     + self._recovery_analysis_text()
+                    + "\n"
+                    + self.subscribe_hub.analysis_text()
                 )
             return ExecuteResult(
                 "text", text=text, columns=("explain",)
@@ -1194,30 +1201,20 @@ class Coordinator:
 
     # -- subscribe ------------------------------------------------------------
     def _sequence_subscribe(self, plan: SubscribePlan) -> ExecuteResult:
+        """SUBSCRIBE through the fan-out hub (ISSUE 11): same-query
+        sessions share ONE dataflow + ONE sink-shard tail; bare-Get
+        subscriptions of durable objects tail the object's own shard
+        with zero installs. Admission sheds with ServerBusy (pgwire
+        53400 / HTTP 503) past subscribe_max_sessions."""
         expr = optimize(self._inline_views(plan.expr))
         imports, index_imports = self._source_imports(expr)
-        self._sub_seq += 1
-        # Unique across coordinator restarts: the sink shard is durable,
-        # so a process-local counter alone would tail a STALE shard from
-        # a previous run's different subscription.
-        import uuid
-
-        name = f"sub{self._sub_seq}-{uuid.uuid4().hex[:8]}"
-        shard = f"{name}_out"
-        as_of = getattr(plan, "as_of", None)
-        self._register_dataflow(
-            DataflowDescription(
-                name=name,
-                expr=expr,
-                source_imports=imports,
-                sink_shard=shard,
-                index_imports=index_imports,
-                as_of=as_of,
-            )
+        sub = self.subscribe_hub.subscribe(
+            expr,
+            imports,
+            index_imports,
+            plan.column_names,
+            as_of=getattr(plan, "as_of", None),
         )
-        sub = Subscription(self, name, shard, expr.schema(),
-                           plan.column_names, as_of=as_of)
-        self.subscriptions[self._sub_seq] = sub
         res = ExecuteResult("subscription", columns=plan.column_names)
         res.subscription = sub
         return res
@@ -1501,6 +1498,10 @@ class Coordinator:
                 f"cannot drop {name!r}: its arrangement is imported by "
                 f"dataflows {importers}"
             )
+        # Subscriptions tailing a dropped object's shard would block
+        # forever on an upper that never advances again: close them
+        # (their wire loops see `closed` and terminate the stream).
+        self.subscribe_hub.close_for(doomed)
         # Remove the durable record (retract by replayed-sql identity).
         for rec in self._catalog_live_records():
             if rec.get("name") == name:
@@ -1900,60 +1901,12 @@ class Coordinator:
 
     def shutdown(self) -> None:
         self._flush_transient_peeks()
-        for sub in list(self.subscriptions.values()):
-            sub.close()
+        self.subscribe_hub.shutdown()
         for src in self.sources.values():
             src.stop()
         for snk in self.sinks.values():
             snk.stop()
         self.controller.shutdown()
-
-
-class Subscription:
-    """SUBSCRIBE: a maintained delta stream of a query's result
-    (sink/subscribe.rs + SUBSCRIBE semantics): the first poll returns
-    the snapshot, subsequent polls return (data, diff) events stamped
-    with the virtual time, interleaved with progress frontiers. Tailing
-    the dataflow's sink shard gives exactly-once delivery across
-    coordinator restarts."""
-
-    def __init__(self, coord, df_name, shard, schema, columns,
-                 as_of: int | None = None):
-        self.coord = coord
-        self.df_name = df_name
-        self.reader = coord.persist.open_reader(shard, f"sub-{df_name}")
-        self.schema = schema
-        self.columns = columns
-        # SUBSCRIBE ... AS OF t: the dataflow hydrated at exactly t (the
-        # sink's first chunk is the collapsed snapshot at t); emit that
-        # snapshot first, then tail deltas beyond it.
-        self.frontier = 0 if as_of is None else as_of
-        self.closed = False
-
-    def poll(self, timeout: float = 5.0):
-        """Returns (events, progress_frontier) or None on timeout. Each
-        event is (vals..., time, diff) with strings decoded and NULLs as
-        None."""
-        got = self.reader.listen_next(self.frontier, timeout)
-        if got is None:
-            return None
-        (_sch, cols, nulls, time, diff), upper = got
-        from ..repr.schema import decode_result_rows
-
-        events = decode_result_rows(self.schema, cols, nulls, time, diff)
-        self.frontier = upper
-        return events, upper
-
-    def close(self) -> None:
-        if self.closed:
-            return
-        self.closed = True
-        self.coord.subscriptions = {
-            k: v for k, v in self.coord.subscriptions.items() if v is not self
-        }
-        self.coord._deregister_dataflow(self.df_name)
-        self.coord.controller.drop_dataflow(self.df_name)
-        self.reader.expire()
 
 
 def _coerce_internal(v, from_col: Column, to_col: Column):

@@ -81,6 +81,18 @@ INTROSPECTION_SCHEMAS: dict[str, Schema] = {
             Column("value", F),
         ]
     ),
+    "mz_subscriptions": Schema(
+        [
+            Column("session", I),
+            Column("dataflow", S),
+            Column("sharers", I),
+            Column("frontier", I),
+            Column("queued", I),
+            Column("delivered", I),
+            Column("sheds", I),
+            Column("lag_ms", F),
+        ]
+    ),
     "mz_metrics": Schema(
         [Column("metric", S), Column("value", F)]
     ),
@@ -263,6 +275,28 @@ def snapshot(coord, name: str) -> list[tuple]:
                          _enc(metric), float(v.get(metric, 0)))
                     )
         return rows
+    if name == "mz_subscriptions":
+        # The push plane's live sessions (ISSUE 11): per session, the
+        # shared tail it rides (`sharers` = sessions on the same tail
+        # — the fan-out sharing made relationally visible), its
+        # delivered progress frontier, queue depth, rows delivered,
+        # slow-consumer sheds, and last observed delivery lag.
+        return [
+            (
+                sid,
+                _enc(df),
+                sharers,
+                frontier,
+                queued,
+                delivered,
+                sheds,
+                float(lag_ms),
+            )
+            for (
+                sid, df, sharers, frontier, queued, delivered, sheds,
+                lag_ms,
+            ) in coord.subscribe_hub.introspection_rows()
+        ]
     if name == "mz_metrics":
         from ..utils.metrics import REGISTRY
 

@@ -1,9 +1,12 @@
-"""HTTP endpoints: SQL-over-HTTP, metrics, readiness.
+"""HTTP endpoints: SQL-over-HTTP, metrics, readiness, SSE SUBSCRIBE.
 
 Analog of the reference's ``environmentd/src/http``: POST /api/sql
 executes statements and returns JSON results; GET /metrics serves the
-Prometheus registry; GET /api/readyz for probes. Stdlib http.server —
-the control plane is not a throughput surface.
+Prometheus registry; GET /api/readyz for probes; GET/POST
+/api/subscribe streams a SUBSCRIBE as Server-Sent Events off the
+fan-out hub (ISSUE 11). Stdlib http.server — the control plane is not
+a throughput surface, but SSE sessions are hub-woken (event-driven),
+so idle streams cost nothing between spans.
 """
 
 from __future__ import annotations
@@ -11,6 +14,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 from ..utils.metrics import REGISTRY
 
@@ -67,7 +71,159 @@ def make_handler(coordinator):
                 body = json.dumps({"error": str(e)}).encode()
                 self._reply(code, body, "application/json")
 
+        def _subscribe_sse(self, sql: str) -> None:
+            """GET/POST /api/subscribe: stream a SUBSCRIBE as
+            Server-Sent Events. Each hub chunk becomes one `data:`
+            message `{"events": [[vals..., time, diff], ...],
+            "progress": frontier}` (plus `"snapshot": true` for state
+            transfers); keepalive comments flush every 15s so a dead
+            client surfaces as a write failure. Admission sheds are
+            503; slow-consumer disconnects end the stream with an
+            `event: error` message."""
+            from ..coord.peek import ServerBusy
+            from ..coord.subscribe import SubscriptionLagging
+
+            if not sql:
+                self._reply(
+                    400,
+                    json.dumps(
+                        {"error": "missing SUBSCRIBE query"}
+                    ).encode(),
+                    "application/json",
+                )
+                return
+            # Validate BEFORE executing: /api/subscribe must never
+            # run a non-SUBSCRIBE statement (a GET carrying an INSERT
+            # would otherwise commit the write and then report 400 —
+            # state-changing "errors" break retry semantics).
+            try:
+                from ..sql import ast as sqlast
+                from ..sql import parser as sqlparser
+
+                stmt = sqlparser.parse_statement(sql)
+                if not isinstance(stmt, sqlast.Subscribe):
+                    raise ValueError(
+                        "/api/subscribe requires a SUBSCRIBE "
+                        "statement"
+                    )
+            except Exception as e:
+                self._reply(
+                    400,
+                    json.dumps({"error": str(e)}).encode(),
+                    "application/json",
+                )
+                return
+            try:
+                res = coordinator.execute(sql)
+            except ServerBusy as e:
+                self._reply(
+                    503,
+                    json.dumps({"error": str(e)}).encode(),
+                    "application/json",
+                )
+                return
+            except Exception as e:
+                self._reply(
+                    400,
+                    json.dumps({"error": str(e)}).encode(),
+                    "application/json",
+                )
+                return
+            if res.kind != "subscription":
+                self._reply(
+                    400,
+                    json.dumps(
+                        {
+                            "error": "/api/subscribe requires a "
+                            "SUBSCRIBE statement"
+                        }
+                    ).encode(),
+                    "application/json",
+                )
+                return
+            sub = res.subscription
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            import select
+
+            wake = sub.wake_socket()
+            conn = self.connection
+            try:
+                self.wfile.write(
+                    b": subscribed columns="
+                    + ",".join(map(str, res.columns)).encode()
+                    + b"\n\n"
+                )
+                self.wfile.flush()
+                while True:
+                    # Drain BEFORE selecting (chunks enqueued before
+                    # the wake fd existed — the join snapshot — have
+                    # no wake byte to select on) and BEFORE honoring
+                    # `closed`: a hub-reaped lagging session still
+                    # owes the client its error (raised by pop_ready),
+                    # not a clean end-of-stream.
+                    for kind, events, frontier, _st in sub.pop_ready():
+                        payload = {
+                            "events": [list(e) for e in events],
+                            "progress": frontier,
+                        }
+                        if kind == "snapshot":
+                            payload["snapshot"] = True
+                        self.wfile.write(
+                            b"data: "
+                            + json.dumps(
+                                payload, default=str
+                            ).encode()
+                            + b"\n\n"
+                        )
+                        self.wfile.flush()
+                    if sub.closed:
+                        return
+                    # Event-driven, like the pgwire COPY-out loop: a
+                    # committed span wakes via the session fd, a
+                    # client close wakes via the connection (EOF —
+                    # SSE clients never send mid-stream, so ANY
+                    # inbound readability is teardown).
+                    ready, _, _ = select.select(
+                        [conn, wake], [], [], 15.0
+                    )
+                    if conn in ready:
+                        return
+                    if wake in ready:
+                        try:
+                            while wake.recv(4096):
+                                pass
+                        except (BlockingIOError, OSError):
+                            pass
+                    if not ready:
+                        # Liveness probe: a half-open (unreachable,
+                        # never-FIN'd) client fails this write.
+                        self.wfile.write(b": keepalive\n\n")
+                        self.wfile.flush()
+            except SubscriptionLagging as e:
+                try:
+                    self.wfile.write(
+                        b"event: error\ndata: "
+                        + json.dumps({"error": str(e)}).encode()
+                        + b"\n\n"
+                    )
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionError, OSError):
+                    pass
+            except (BrokenPipeError, ConnectionError, OSError):
+                pass
+            finally:
+                sub.close()
+
         def do_GET(self):
+            if self.path.startswith("/api/subscribe"):
+                qs = parse_qs(urlparse(self.path).query)
+                self._subscribe_sse(
+                    (qs.get("query") or [""])[0].strip()
+                )
+                return
             if self.path == "/metrics":
                 self._reply(
                     200, REGISTRY.expose_text().encode(),
@@ -81,6 +237,15 @@ def make_handler(coordinator):
         def do_POST(self):
             if self.path.startswith("/api/webhook/"):
                 self._webhook(self.path[len("/api/webhook/"):])
+                return
+            if self.path.startswith("/api/subscribe"):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    sql = str(body.get("query", "")).strip()
+                except Exception:
+                    sql = ""
+                self._subscribe_sse(sql)
                 return
             if self.path != "/api/sql":
                 self._reply(404, b"not found\n", "text/plain")
@@ -122,8 +287,9 @@ def make_handler(coordinator):
                         res.subscription.close()
                         results.append(
                             {
-                                "error": "SUBSCRIBE is not supported "
-                                "over HTTP; use pgwire"
+                                "error": "SUBSCRIBE over /api/sql "
+                                "cannot stream; use the "
+                                "/api/subscribe SSE endpoint"
                             }
                         )
                     else:

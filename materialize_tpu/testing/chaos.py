@@ -644,6 +644,343 @@ def seeded_fault_plan(
     return plan
 
 
+# ---------------------------------------------------------------------------
+# the subscriber storm (ISSUE 11): push-plane lifecycle under churn
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SubscriberStormReport:
+    """Push-plane chaos outcome: clients die abruptly mid-storm (raw
+    socket close, SIGKILL'd subprocesses, mid-snapshot drops) while
+    ingest churns; at the end every SURVIVING session's replayed
+    stream must reconstruct the exact oracle multiset, and closing the
+    last session must leave NO leaked dataflows, tails, or persist
+    readers — the drop-exactly-once invariant as a counted check."""
+
+    subscribers: int = 0
+    pgwire_clients: int = 0
+    sigkill_clients: int = 0
+    killed_sessions: int = 0
+    killed_sockets: int = 0
+    ticks: int = 0
+    installs: int = 0
+    readbacks: int = 0
+    spans: int = 0
+    failures: list = field(default_factory=list)
+    oracle: dict = field(default_factory=dict)
+    elapsed_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+def _pg_startup(sock) -> None:
+    import struct
+
+    payload = struct.pack("!I", 196608) + b"user\x00chaos\x00\x00"
+    sock.sendall(struct.pack("!I", len(payload) + 4) + payload)
+    # Read until ReadyForQuery ('Z').
+    buf = b""
+    while True:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ConnectionError("server closed during startup")
+        buf += chunk
+        if b"Z" in buf[-16:]:
+            return
+
+
+def _pg_subscribe(port: int, sql: str):
+    """A raw pgwire client mid-SUBSCRIBE: startup, send the query,
+    read the CopyOutResponse, return the live socket (the caller
+    kills it abruptly)."""
+    import struct
+
+    sock = socket.create_connection(("127.0.0.1", port), 10)
+    _pg_startup(sock)
+    payload = sql.encode() + b"\x00"
+    sock.sendall(b"Q" + struct.pack("!I", len(payload) + 4) + payload)
+    sock.settimeout(10.0)
+    tag = sock.recv(1)
+    assert tag == b"H", f"expected CopyOutResponse, got {tag!r}"
+    (length,) = struct.unpack("!I", sock.recv(4))
+    got = b""
+    while len(got) < length - 4:
+        got += sock.recv(length - 4 - len(got))
+    sock.settimeout(None)
+    return sock
+
+
+_SIGKILL_CLIENT_SRC = """
+import socket, struct, sys, time
+sock = socket.create_connection(("127.0.0.1", int(sys.argv[1])), 10)
+payload = struct.pack("!I", 196608) + b"user\\x00chaos\\x00\\x00"
+sock.sendall(struct.pack("!I", len(payload) + 4) + payload)
+buf = b""
+while b"Z" not in buf[-16:]:
+    buf += sock.recv(4096)
+q = sys.argv[2].encode() + b"\\x00"
+sock.sendall(b"Q" + struct.pack("!I", len(q) + 4) + q)
+print("streaming", flush=True)
+while True:
+    if not sock.recv(65536):
+        break
+"""
+
+
+def run_subscriber_storm(
+    data_dir: str,
+    seed: int = 0,
+    ticks: int = 24,
+    subscribers: int = 12,
+    kills: int = 4,
+    pgwire_clients: int = 3,
+    sigkill_clients: int = 0,
+) -> SubscriberStormReport:
+    """Drive a coordinator + replica + pgwire server with a mixed
+    subscriber population (hub sessions on a SHARED query dataflow,
+    direct table tails, raw pgwire COPY-out clients) under seeded
+    insert/retraction churn, killing a seeded subset abruptly
+    mid-storm (including one mid-snapshot). Verifies exact delivery
+    on every survivor and zero leaked dataflows/tails/readers after
+    the last close."""
+    from ..coord.coordinator import Coordinator
+    from ..coord.protocol import PersistLocation
+    from ..coord.replica import serve_forever
+    from ..server.pgwire import PgServer
+    from ..storage.persist import (
+        FileBlob,
+        PersistClient,
+        SqliteConsensus,
+    )
+
+    t0 = _time.monotonic()
+    rng = random.Random(seed ^ 0x5B5C)
+    os.makedirs(data_dir, exist_ok=True)
+    loc = PersistLocation(
+        os.path.join(data_dir, "blob"),
+        os.path.join(data_dir, "consensus.db"),
+    )
+    port = _free_port()
+    ready = threading.Event()
+    threading.Thread(
+        target=serve_forever, args=(port, loc, "r0", ready),
+        daemon=True,
+    ).start()
+    ready.wait(10)
+    coord = Coordinator(
+        PersistClient(
+            FileBlob(loc.blob_root), SqliteConsensus(loc.consensus_path)
+        ),
+        tick_interval=None,
+    )
+    coord.add_replica("r0", ("127.0.0.1", port))
+    pg = PgServer(coord).start()
+    rep = SubscriberStormReport(
+        subscribers=subscribers,
+        pgwire_clients=pgwire_clients,
+        sigkill_clients=sigkill_clients,
+        ticks=ticks,
+    )
+    procs: list = []
+    sockets: list = []
+    try:
+        coord.execute(
+            "CREATE TABLE kv (k BIGINT NOT NULL, v BIGINT NOT NULL)"
+        )
+        coord.execute("INSERT INTO kv VALUES (0, 0)")
+        # Generous queue: survivors drain only at the end.
+        coord.update_config({"subscribe_queue_depth": 1_000_000})
+        query_sql = "SUBSCRIBE TO (SELECT k, v FROM kv WHERE k >= 0)"
+        sessions = []
+        for i in range(subscribers):
+            sql = query_sql if i % 2 == 0 else "SUBSCRIBE kv"
+            sessions.append(coord.execute(sql).subscription)
+        for _ in range(pgwire_clients):
+            sockets.append(_pg_subscribe(pg.port, "SUBSCRIBE kv"))
+        if sigkill_clients and subprocess_available():
+            for _ in range(sigkill_clients):
+                p = subprocess.Popen(
+                    [sys.executable, "-c", _SIGKILL_CLIENT_SRC,
+                     str(pg.port), "SUBSCRIBE kv"],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL,
+                )
+                p.stdout.readline()  # "streaming": mid-COPY-out
+                procs.append(p)
+        # One client dies MID-SNAPSHOT: subscribe and kill before
+        # reading a single CopyData frame.
+        mid_snap = socket.create_connection(("127.0.0.1", pg.port), 10)
+        _pg_startup(mid_snap)
+        import struct as _struct
+
+        q = b"SUBSCRIBE kv\x00"
+        mid_snap.sendall(
+            b"Q" + _struct.pack("!I", len(q) + 4) + q
+        )
+        from ..coord.protocol import hard_close
+
+        hard_close(mid_snap)
+        rep.killed_sockets += 1
+        # The storm: seeded inserts + retraction bursts, with abrupt
+        # client deaths interleaved.
+        oracle: dict = {(0, 0): 1}
+        kill_ticks = set(
+            rng.sample(range(2, max(3, ticks - 2)),
+                       min(kills, max(1, ticks - 4)))
+        )
+        live = [(0, 0)]
+        for t in range(ticks):
+            ups = []
+            for _ in range(rng.randrange(1, 4)):
+                k, v = rng.randrange(6), rng.randrange(100)
+                ups.append(f"({k}, {v})")
+                oracle[(k, v)] = oracle.get((k, v), 0) + 1
+                live.append((k, v))
+            coord.execute(
+                "INSERT INTO kv VALUES " + ", ".join(ups)
+            )
+            if live and rng.random() < 0.5:
+                rk, rv = rng.choice(live)
+                n = oracle.pop((rk, rv), 0)
+                if n:
+                    coord.execute(
+                        f"DELETE FROM kv WHERE k = {rk} AND v = {rv}"
+                    )
+                live = [p for p in live if p != (rk, rv)]
+            if t in kill_ticks:
+                victim = rng.randrange(3)
+                if victim == 0 and len(sessions) > 2:
+                    # Abrupt session close (the wire layer died).
+                    sessions.pop(
+                        rng.randrange(len(sessions))
+                    ).close()
+                    rep.killed_sessions += 1
+                elif victim == 1 and sockets:
+                    hard_close(sockets.pop(rng.randrange(len(sockets))))
+                    rep.killed_sockets += 1
+                elif procs:
+                    p = procs.pop(rng.randrange(len(procs)))
+                    p.kill()
+                    p.wait()
+                    rep.killed_sockets += 1
+        rep.oracle = dict(oracle)
+        # Wait until the final frontier reaches every surviving
+        # session, then verify reconstruction: snapshot chunks RESET
+        # state, delta chunks apply.
+        final = coord._table_writers["kv"].upper
+        deadline = _time.monotonic() + 120.0
+        for s in sessions:
+            state: dict = {}
+            while s.frontier < final:
+                if _time.monotonic() > deadline:
+                    rep.failures.append(
+                        f"session {s.session_id} stuck at frontier "
+                        f"{s.frontier} < {final}"
+                    )
+                    break
+                if not s.wait(1.0):
+                    continue
+                for kind, events, _up, _st in s.pop_ready():
+                    if kind == "snapshot":
+                        state = {}
+                    for ev in events:
+                        key = tuple(ev[:-2])
+                        state[key] = state.get(key, 0) + ev[-1]
+            for kind, events, _up, _st in s.pop_ready():
+                if kind == "snapshot":
+                    state = {}
+                for ev in events:
+                    key = tuple(ev[:-2])
+                    state[key] = state.get(key, 0) + ev[-1]
+            got = {k: n for k, n in state.items() if n}
+            if got != oracle:
+                rep.failures.append(
+                    f"session {s.session_id} diverged: "
+                    f"missing={ {k: n for k, n in oracle.items() if got.get(k) != n} } "
+                    f"extra={ {k: n for k, n in got.items() if oracle.get(k) != n} }"
+                )
+        snap = coord.subscribe_hub.snapshot()
+        rep.installs = snap["installs"]
+        rep.readbacks = snap["readbacks"]
+        rep.spans = snap["spans"]
+        if snap["installs"] > 1:
+            rep.failures.append(
+                f"{snap['installs']} dataflow installs for ONE shared "
+                "query (expected exactly 1)"
+            )
+        if snap["spans"] and snap["readbacks"] != snap["spans"]:
+            rep.failures.append(
+                f"readbacks {snap['readbacks']} != spans "
+                f"{snap['spans']}: the one-readback-per-span "
+                "invariant broke"
+            )
+        # Close every survivor; the pgwire/SIGKILL clients' sessions
+        # must have been reaped by their wire loops already (bounded
+        # wait: half-close detection is event-driven, not instant).
+        for s in sessions:
+            s.close()
+        for sock in sockets:
+            hard_close(sock)
+        for p in procs:
+            p.kill()
+            p.wait()
+        deadline = _time.monotonic() + 30.0
+        while (
+            coord.subscribe_hub.session_count()
+            and _time.monotonic() < deadline
+        ):
+            _time.sleep(0.05)
+        leaked_sessions = coord.subscribe_hub.session_count()
+        if leaked_sessions:
+            rep.failures.append(
+                f"{leaked_sessions} sessions leaked after every "
+                "client died"
+            )
+        with coord.subscribe_hub._lock:
+            leaked_tails = list(coord.subscribe_hub._tails)
+        if leaked_tails:
+            rep.failures.append(f"tails leaked: {leaked_tails}")
+        with coord.controller._lock:
+            leaked_dfs = [
+                n for n in coord.controller._dataflows
+                if n.startswith("sub")
+            ]
+        if leaked_dfs:
+            rep.failures.append(
+                f"subscription dataflows leaked: {leaked_dfs}"
+            )
+        drops = coord.subscribe_hub.stats["drops"]
+        if drops != rep.installs:
+            rep.failures.append(
+                f"drop-exactly-once violated: {rep.installs} installs "
+                f"vs {drops} drops"
+            )
+        for shard, machine in coord.persist._machines.items():
+            holds = [
+                r
+                for r, _s in machine.reload().reader_holds
+                if r.startswith("subtail-")
+            ]
+            if holds:
+                rep.failures.append(
+                    f"persist readers leaked on {shard!r}: {holds}"
+                )
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+                p.wait()
+            except Exception:
+                pass
+        pg.stop()
+        coord.shutdown()
+    rep.elapsed_s = _time.monotonic() - t0
+    return rep
+
+
 def run_chaos(
     data_dir: str,
     seed: int = 0,

@@ -263,6 +263,42 @@ BUFFER_SANITIZER = Config(
     "dispatch)",
 ).register(COMPUTE_CONFIGS)
 
+# -- the push serving plane (ISSUE 11 / ROADMAP item 3) ----------------------
+
+SUBSCRIBE_MAX_SESSIONS = Config(
+    "subscribe_max_sessions", 10000,
+    "admission control for the push plane: max live SUBSCRIBE "
+    "sessions across the coordinator; arrivals beyond this are shed "
+    "with 'server busy' (SQLSTATE 53400 at pgwire, HTTP 503) instead "
+    "of degrading every existing stream",
+).register(COMPUTE_CONFIGS)
+
+SUBSCRIBE_QUEUE_DEPTH = Config(
+    "subscribe_queue_depth", 8192,
+    "per-session delivery queue bound, in rows: a consumer that "
+    "cannot drain its deltas this far behind the shared tail is "
+    "handled by subscribe_slow_policy instead of buffering without "
+    "bound (the hub's queues are the only per-subscriber state)",
+).register(COMPUTE_CONFIGS)
+
+SUBSCRIBE_SLOW_POLICY = Config(
+    "subscribe_slow_policy", "disconnect",
+    "what happens to a subscriber whose queue exceeds "
+    "subscribe_queue_depth: 'disconnect' terminates the session with "
+    "a retryable error; 'coalesce' drops the queued deltas and "
+    "re-delivers a collapsed snapshot at the current frontier (state "
+    "transfer — correct for dashboard-class consumers that only need "
+    "current state, at the cost of one extra shard read)",
+).register(COMPUTE_CONFIGS)
+
+SUBSCRIBE_TAIL_POLL_MS = Config(
+    "subscribe_tail_poll_ms", 50.0,
+    "shared-tail wait granularity: how long one listen cycle blocks "
+    "for the sink shard's upper to advance before re-checking for "
+    "retirement (bounds tail-thread teardown latency, NOT delivery "
+    "latency — data wakes the listen immediately)",
+).register(COMPUTE_CONFIGS)
+
 TRANSIENT_PEEK_CACHE = Config(
     "transient_peek_cache", 8,
     "memoize slow-path SELECT dataflows by description fingerprint: "

@@ -305,7 +305,152 @@ def run_bench_mode(verbose: bool) -> int:
     rc |= run_sharding_gates(gate, budgets)
     rc |= run_lockcheck_smoke(gate)
     rc |= run_chaos_smoke(gate)
+    rc |= run_subscribe_smoke(gate, budgets)
     return rc
+
+
+def run_subscribe_smoke(gate, budgets: dict) -> int:
+    """Push-plane smoke gate (ISSUE 11 satellite): a small hub run —
+    >= 8 concurrent same-query SUBSCRIBE sessions over one table
+    under churn — asserting the two structural invariants:
+
+      * readbacks-per-span == 1.0 (each committed span window is
+        fetched from the sink shard ONCE for ALL sessions; a
+        per-session tail regression makes this N);
+      * exactly ONE dataflow install shared by every session;
+
+    plus the zero-device-programs fact: the fan-out hub is pure host
+    code, so tests/kernel_budget.json must carry NO subscribe-plane
+    program budgets (a key appearing there means someone put device
+    work on the push path — that is a cost-model change this gate
+    makes deliberate, not accidental)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from materialize_tpu.analysis import LintFinding
+
+    findings = []
+    stray = [
+        k for k in budgets
+        if k.startswith("subscribe") or k.startswith("sub_")
+    ]
+    if stray:
+        findings.append(
+            LintFinding(
+                "subscribe-smoke", "kernel-budget",
+                f"kernel_budget.json has subscribe-plane entries "
+                f"{stray}: the push plane is host-side by design "
+                "(one shard readback per span, zero device "
+                "programs); adding device work to it changes the "
+                "cost model in doc/perf.md",
+            )
+        )
+    storm_dir = tempfile.mkdtemp(prefix="subscribe-gate-")
+    try:
+        from materialize_tpu.coord.coordinator import Coordinator
+        from materialize_tpu.coord.protocol import PersistLocation
+        from materialize_tpu.coord.replica import serve_forever
+        from materialize_tpu.storage.persist import (
+            FileBlob,
+            PersistClient,
+            SqliteConsensus,
+        )
+
+        loc = PersistLocation(
+            os.path.join(storm_dir, "blob"),
+            os.path.join(storm_dir, "consensus.db"),
+        )
+        from materialize_tpu.testing.chaos import _free_port
+
+        port = _free_port()
+        ready = threading.Event()
+        threading.Thread(
+            target=serve_forever, args=(port, loc, "r0", ready),
+            daemon=True,
+        ).start()
+        ready.wait(10)
+        coord = Coordinator(
+            PersistClient(
+                FileBlob(loc.blob_root),
+                SqliteConsensus(loc.consensus_path),
+            ),
+            tick_interval=None,
+        )
+        coord.add_replica("r0", ("127.0.0.1", port))
+        try:
+            coord.execute(
+                "CREATE TABLE skv (k BIGINT NOT NULL, "
+                "v BIGINT NOT NULL)"
+            )
+            coord.execute("INSERT INTO skv VALUES (0, 0)")
+            sql = "SUBSCRIBE TO (SELECT k, v FROM skv WHERE k >= 0)"
+            subs = [
+                coord.execute(sql).subscription for _ in range(8)
+            ]
+            for i in range(4):
+                coord.execute(
+                    f"INSERT INTO skv VALUES ({i + 1}, {i})"
+                )
+            final = coord._table_writers["skv"].upper
+            import time as _t
+
+            deadline = _t.monotonic() + 120.0
+            while any(s.frontier < final for s in subs):
+                if _t.monotonic() > deadline:
+                    findings.append(
+                        LintFinding(
+                            "subscribe-smoke", "delivery",
+                            "sessions never reached the final "
+                            f"frontier {final}: "
+                            f"{[s.frontier for s in subs]}",
+                        )
+                    )
+                    break
+                for s in subs:
+                    s.pop_ready()
+                _t.sleep(0.01)
+            snap = coord.subscribe_hub.snapshot()
+            if snap["installs"] != 1:
+                findings.append(
+                    LintFinding(
+                        "subscribe-smoke", "sharing",
+                        f"{snap['installs']} dataflow installs for 8 "
+                        "same-query sessions (expected exactly 1: "
+                        "the hub's expr-fingerprint sharing broke)",
+                    )
+                )
+            if (
+                not snap["spans"]
+                or snap["readbacks"] != snap["spans"]
+            ):
+                findings.append(
+                    LintFinding(
+                        "subscribe-smoke", "invariant",
+                        f"readbacks {snap['readbacks']} != spans "
+                        f"{snap['spans']} across 8 sessions: the "
+                        "one-readback-per-span invariant broke "
+                        "(per-session tails?)",
+                    )
+                )
+            for s in subs:
+                s.close()
+        finally:
+            coord.shutdown()
+    except OSError as e:
+        print(f"subscribe-smoke: skipped (environment: {e!r})")
+        return 0
+    except Exception as e:
+        findings.append(
+            LintFinding(
+                "subscribe-smoke", "driver",
+                f"subscribe smoke failed to run: {e!r}",
+            )
+        )
+    finally:
+        shutil.rmtree(storm_dir, ignore_errors=True)
+    gate("subscribe-smoke", None, findings, 0)
+    return 1 if findings else 0
 
 
 def run_chaos_smoke(gate) -> int:

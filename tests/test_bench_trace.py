@@ -209,3 +209,83 @@ def test_multichip_census_and_prover_gate(multichip_output):
         "bytes": 8 * t,
         "kinds": {"psum": t},
     }
+
+
+# -- bench.py --subscribe (ISSUE 11 satellite) -------------------------------
+# The push-plane fan-out bench must count its structural claims in the
+# JSON: ONE dataflow install shared by every same-query subscriber, and
+# exactly one sink-shard readback per span window (a per-session tail
+# regression multiplies readbacks by the session count and fails here,
+# on CPU, before any scale run).
+
+SUBSCRIBE_TOP_KEYS = {
+    "mode",
+    "schema_version",
+    "backend",
+    "subscribers",
+    "requested_subscribers",
+    "duration_s",
+    "join_s",
+    "admission_shed",
+    "dataflow_installs",
+    "shared_joins",
+    "shared_tails",
+    "readbacks",
+    "spans",
+    "readbacks_per_span",
+    "naive_readbacks_avoided",
+    "ingest_ticks",
+    "rows_written",
+    "updates_per_s",
+    "deltas_delivered",
+    "chunks_measured",
+    "delivery_p50_ms",
+    "delivery_p99_ms",
+    "slow_consumer_sheds",
+    "sessions_caught_up",
+    "valid",
+}
+
+
+@pytest.fixture(scope="module")
+def subscribe_output():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--subscribe", "12", "3"],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l]
+    assert lines, "no subscribe output emitted"
+    return json.loads(lines[-1])
+
+
+def test_subscribe_json_schema_stable(subscribe_output):
+    o = subscribe_output
+    assert o["mode"] == "subscribe"
+    assert o["schema_version"] == 1
+    assert SUBSCRIBE_TOP_KEYS <= set(o)
+    assert o["subscribers"] == 12
+
+
+def test_subscribe_shares_one_dataflow_one_readback_per_span(
+    subscribe_output,
+):
+    """The deliverable facts (ISSUE 11 acceptance): N same-query
+    SUBSCRIBEs share ONE dataflow install, the hub reads each span
+    window back exactly once for all of them, and every session
+    reaches the final frontier."""
+    o = subscribe_output
+    assert o["dataflow_installs"] == 1
+    assert o["shared_joins"] == o["subscribers"] - 1
+    assert o["readbacks_per_span"] == 1.0
+    assert o["readbacks"] == o["spans"]
+    assert o["sessions_caught_up"] == o["subscribers"]
+    assert o["deltas_delivered"] > 0
+    assert o["valid"] is True

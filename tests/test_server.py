@@ -4,6 +4,7 @@ SQL-over-HTTP, /metrics, and the environmentd boot path (SURVEY.md L0)."""
 import json
 import socket
 import struct
+import urllib.error
 import urllib.request
 
 import pytest
@@ -352,6 +353,54 @@ class TestPgwire:
         assert err is None and rows == [("1",)]
         c.close()
 
+    def test_subscribe_copy_fail_ends_stream_cleanly(self, env):
+        """ISSUE 11 satellite: a client-sent CopyFail mid-SUBSCRIBE
+        ends the stream and deregisters the hub session (the old 1s
+        MSG_PEEK heartbeat could only detect full closes)."""
+        import time as _time
+
+        c = MiniPg(env.pg.port)
+        c.query("CREATE TABLE cf (v bigint NOT NULL)")
+        c.query("INSERT INTO cf VALUES (1)")
+        before = env.coord.subscribe_hub.session_count()
+        payload = b"SUBSCRIBE cf\x00"
+        c.sock.sendall(
+            b"Q" + struct.pack("!I", len(payload) + 4) + payload
+        )
+        tag, _ = c._read_msg()
+        assert tag == b"H"  # CopyOutResponse
+        tag, _ = c._read_msg()
+        assert tag == b"d"  # the snapshot window
+        # CopyFail: the server must tear the subscription down...
+        c._send_msg(b"f", b"client aborted\x00")
+        deadline = _time.monotonic() + 10.0
+        while env.coord.subscribe_hub.session_count() > before:
+            assert _time.monotonic() < deadline
+            _time.sleep(0.02)
+        c.sock.close()
+
+    def test_subscribe_client_terminate_reaps_session(self, env):
+        """Terminate ('X') mid-COPY-out ends both the stream and the
+        connection; the hub session is reaped."""
+        import time as _time
+
+        c = MiniPg(env.pg.port)
+        c.query("CREATE TABLE tm (v bigint NOT NULL)")
+        c.query("INSERT INTO tm VALUES (2)")
+        before = env.coord.subscribe_hub.session_count()
+        payload = b"SUBSCRIBE tm\x00"
+        c.sock.sendall(
+            b"Q" + struct.pack("!I", len(payload) + 4) + payload
+        )
+        tag, _ = c._read_msg()
+        assert tag == b"H"
+        c._send_msg(b"X", b"")
+        deadline = _time.monotonic() + 10.0
+        while env.coord.subscribe_hub.session_count() > before:
+            assert _time.monotonic() < deadline
+            _time.sleep(0.02)
+        c.sock.close()
+
 
 class TestHttp:
     def test_sql_metrics_ready(self, env):
@@ -373,6 +422,62 @@ class TestHttp:
         with urllib.request.urlopen(base + "/metrics") as r:
             text = r.read().decode()
         assert text.startswith("#") or text.strip() == ""
+
+    def test_subscribe_sse_stream(self, env):
+        """ISSUE 11: GET /api/subscribe streams SUBSCRIBE as
+        Server-Sent Events — snapshot first, then live deltas as the
+        table changes (server/http.py previously refused SUBSCRIBE)."""
+        import urllib.parse
+
+        base = f"http://127.0.0.1:{env.http.port}"
+        self._http_sql(env, "CREATE TABLE sse (x bigint NOT NULL)")
+        self._http_sql(env, "INSERT INTO sse VALUES (41)")
+        url = base + "/api/subscribe?query=" + urllib.parse.quote(
+            "SUBSCRIBE sse"
+        )
+        r = urllib.request.urlopen(url, timeout=30)
+        assert r.headers.get("Content-Type") == "text/event-stream"
+
+        def next_data(resp):
+            while True:
+                line = resp.readline()
+                assert line, "stream closed early"
+                if line.startswith(b"data: "):
+                    return json.loads(line[len(b"data: "):])
+
+        first = next_data(r)
+        assert first.get("snapshot") is True
+        assert [[e[0], e[-1]] for e in first["events"]] == [[41, 1]]
+        self._http_sql(env, "INSERT INTO sse VALUES (42)")
+        saw = []
+        while not saw:
+            msg = next_data(r)
+            saw = [e for e in msg["events"] if e[0] == 42]
+        assert saw[0][-1] == 1
+        r.close()  # client drop: server reaps the session
+
+    def test_subscribe_sse_rejects_non_subscribe(self, env):
+        base = f"http://127.0.0.1:{env.http.port}"
+        req = urllib.request.Request(
+            base + "/api/subscribe",
+            data=json.dumps({"query": "SELECT 1"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected HTTP 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "SUBSCRIBE" in json.loads(e.read())["error"]
+
+    def _http_sql(self, env, sql: str):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{env.http.port}/api/sql",
+            data=json.dumps({"query": sql}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
 
 
 class TestPeekParity:

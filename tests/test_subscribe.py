@@ -1,0 +1,661 @@
+"""Push-plane tests (ISSUE 11): the SUBSCRIBE fan-out hub.
+
+Pins the structural claims of coord/subscribe.py: N same-query
+SUBSCRIBEs share ONE dataflow (dropped exactly once when the last
+sharer leaves); bare-Get subscriptions of durable objects tail the
+object's shard with zero installs; snapshot+updates reconstructs the
+exact host oracle at every delivered progress frontier under
+duplicate/retraction churn; exactly-once resume across a coordinator
+restart; admission and slow-consumer backpressure; and the
+mz_subscriptions / EXPLAIN ANALYSIS surfaces."""
+
+import random
+import threading
+
+import pytest
+
+from materialize_tpu.coord.coordinator import Coordinator
+from materialize_tpu.coord.peek import ServerBusy
+from materialize_tpu.coord.protocol import PersistLocation
+from materialize_tpu.coord.replica import serve_forever
+from materialize_tpu.coord.subscribe import SubscriptionLagging
+from materialize_tpu.storage.persist import (
+    FileBlob,
+    PersistClient,
+    SqliteConsensus,
+)
+from materialize_tpu.utils.dyncfg import COMPUTE_CONFIGS
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """One in-process replica + a coordinator factory over a shared
+    persist location (the restart tests build a second coordinator)."""
+    loc = PersistLocation(
+        str(tmp_path / "blob"), str(tmp_path / "consensus.db")
+    )
+    port = _free_port()
+    ready = threading.Event()
+    threading.Thread(
+        target=serve_forever, args=(port, loc, "r0", ready), daemon=True
+    ).start()
+    assert ready.wait(10)
+    coords = []
+
+    def make_coord():
+        c = Coordinator(
+            PersistClient(
+                FileBlob(loc.blob_root),
+                SqliteConsensus(loc.consensus_path),
+            ),
+            tick_interval=None,
+        )
+        c.add_replica("r0", ("127.0.0.1", port))
+        coords.append(c)
+        return c
+
+    yield make_coord
+    for c in coords:
+        c.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _reset_subscribe_dyncfg():
+    yield
+    COMPUTE_CONFIGS.update(
+        {
+            "subscribe_max_sessions": None,
+            "subscribe_queue_depth": None,
+            "subscribe_slow_policy": None,
+        }
+    )
+
+
+def _apply(state: dict, chunks) -> dict:
+    """Replay hub chunks into a multiset: snapshot chunks RESET the
+    state (state transfer), delta chunks apply diffs."""
+    for kind, events, _upper, _stamp in chunks:
+        if kind == "snapshot":
+            state = {}
+        for ev in events:
+            key = tuple(ev[:-2])
+            state[key] = state.get(key, 0) + ev[-1]
+    return {k: n for k, n in state.items() if n}
+
+
+def _drain_until(session, frontier, timeout=60.0, state=None):
+    import time as _t
+
+    state = dict(state or {})
+    deadline = _t.monotonic() + timeout
+    while session.frontier < frontier:
+        assert _t.monotonic() < deadline, (
+            f"session stuck at {session.frontier} < {frontier}"
+        )
+        if session.wait(1.0):
+            state = _apply(state, session.pop_ready())
+    state = _apply(state, session.pop_ready())
+    return state
+
+
+class TestSharing:
+    def test_same_query_subscribes_share_one_dataflow(self, cluster):
+        coord = cluster()
+        coord.execute(
+            "CREATE TABLE kv (k BIGINT NOT NULL, v BIGINT NOT NULL)"
+        )
+        coord.execute("INSERT INTO kv VALUES (1, 10), (2, 20)")
+        sql = "SUBSCRIBE TO (SELECT k, v FROM kv WHERE k >= 0)"
+        subs = [coord.execute(sql).subscription for _ in range(6)]
+        with coord.controller._lock:
+            sub_dfs = [
+                n for n in coord.controller._dataflows
+                if n.startswith("sub")
+            ]
+        assert len(sub_dfs) == 1, sub_dfs
+        assert coord.subscribe_hub.stats["installs"] == 1
+        assert coord.subscribe_hub.stats["shared_joins"] >= 5
+        # Every sharer sees the data AND the same deltas.
+        final = coord._table_writers["kv"].upper
+        states = [_drain_until(s, final) for s in subs]
+        assert all(st == {(1, 10): 1, (2, 20): 1} for st in states)
+        coord.execute("INSERT INTO kv VALUES (3, 30)")
+        final = coord._table_writers["kv"].upper
+        states = [
+            _drain_until(s, final, state=st)
+            for s, st in zip(subs, states)
+        ]
+        assert all(
+            st == {(1, 10): 1, (2, 20): 1, (3, 30): 1}
+            for st in states
+        )
+        # Closing all but one keeps the dataflow; the LAST close
+        # drops it exactly once.
+        for s in subs[:-1]:
+            s.close()
+        with coord.controller._lock:
+            assert sub_dfs[0] in coord.controller._dataflows
+        subs[-1].close()
+        with coord.controller._lock:
+            assert sub_dfs[0] not in coord.controller._dataflows
+        assert coord.subscribe_hub.stats["drops"] == 1
+        # Idempotent: double-close must not double-drop.
+        subs[-1].close()
+        assert coord.subscribe_hub.stats["drops"] == 1
+        assert coord.subscribe_hub.snapshot()["tails"] == []
+
+    def test_bare_get_tails_object_shard_with_zero_installs(
+        self, cluster
+    ):
+        coord = cluster()
+        coord.execute("CREATE TABLE t (x BIGINT NOT NULL)")
+        coord.execute("INSERT INTO t VALUES (7)")
+        subs = [
+            coord.execute("SUBSCRIBE t").subscription
+            for _ in range(3)
+        ]
+        assert coord.subscribe_hub.stats["installs"] == 0
+        with coord.controller._lock:
+            assert not any(
+                n.startswith("sub")
+                for n in coord.controller._dataflows
+            )
+        final = coord._table_writers["t"].upper
+        for s in subs:
+            assert _drain_until(s, final) == {(7,): 1}
+        # One shared tail, one readback per window regardless of the
+        # three sessions.
+        snap = coord.subscribe_hub.snapshot()
+        assert len(snap["tails"]) == 1
+        assert snap["readbacks"] == snap["spans"]
+        for s in subs:
+            s.close()
+
+    def test_readbacks_do_not_scale_with_sessions(self, cluster):
+        coord = cluster()
+        coord.execute("CREATE TABLE rt (x BIGINT NOT NULL)")
+        coord.execute("INSERT INTO rt VALUES (0)")
+        subs = [
+            coord.execute("SUBSCRIBE rt").subscription
+            for _ in range(8)
+        ]
+        for i in range(4):
+            coord.execute(f"INSERT INTO rt VALUES ({i + 1})")
+        final = coord._table_writers["rt"].upper
+        for s in subs:
+            _drain_until(s, final)
+        snap = coord.subscribe_hub.snapshot()
+        # THE invariant: one fetch per span window, not one per
+        # (window x session) — 8 sessions would make this 8x.
+        assert snap["readbacks"] == snap["spans"]
+        assert snap["readbacks_per_span"] == 1.0
+        assert 0 < snap["readbacks"] <= 5 + 1
+        for s in subs:
+            s.close()
+
+
+class TestSnapshotUpdatesOracle:
+    def test_snapshot_plus_updates_reconstructs_oracle(self, cluster):
+        """Property (ISSUE 11 satellite): under seeded duplicate +
+        retraction churn, every subscriber's replayed stream equals
+        the host oracle (an independent read of the durable shard) at
+        EVERY delivered progress frontier — early joiner and
+        mid-stream joiner alike."""
+        coord = cluster()
+        coord.execute(
+            "CREATE TABLE pu (k BIGINT NOT NULL, v BIGINT NOT NULL)"
+        )
+        coord.execute("INSERT INTO pu VALUES (0, 0), (0, 0)")  # dup
+        early = coord.execute(
+            "SUBSCRIBE TO (SELECT k, v FROM pu WHERE k >= 0)"
+        ).subscription
+        rng = random.Random(7)
+        live = [(0, 0), (0, 0)]
+        mid = None
+        for t in range(12):
+            ups = []
+            for _ in range(rng.randrange(1, 3)):
+                k, v = rng.randrange(4), rng.randrange(8)
+                ups.append(f"({k}, {v})")
+                live.append((k, v))
+            coord.execute("INSERT INTO pu VALUES " + ", ".join(ups))
+            if live and rng.random() < 0.5:
+                rk, rv = rng.choice(live)
+                coord.execute(
+                    f"DELETE FROM pu WHERE k = {rk} AND v = {rv}"
+                )
+                live = [p for p in live if p != (rk, rv)]
+            if t == 5:
+                mid = coord.execute("SUBSCRIBE pu").subscription
+        final = coord._table_writers["pu"].upper
+        shard = coord.catalog.items["pu"].definition["shard"]
+
+        def oracle_at(frontier: int) -> dict:
+            reader = coord.persist.open_reader(shard, "test-oracle")
+            try:
+                _s, cols, _n, _t, diff = reader.snapshot(frontier - 1)
+            finally:
+                reader.expire()
+            acc: dict = {}
+            for i in range(len(diff)):
+                key = tuple(int(c[i]) for c in cols)
+                acc[key] = acc.get(key, 0) + int(diff[i])
+            return {k: n for k, n in acc.items() if n}
+
+        for sub in (early, mid):
+            state: dict = {}
+            import time as _t
+
+            deadline = _t.monotonic() + 60.0
+            while sub.frontier < final:
+                assert _t.monotonic() < deadline
+                if not sub.wait(1.0):
+                    continue
+                for chunk in sub.pop_ready():
+                    state = _apply(state, [chunk])
+                    # The multiset at EVERY delivered frontier matches
+                    # the durable truth at that frontier: never a
+                    # half-applied carry, never a skipped window.
+                    assert state == oracle_at(chunk[2]), (
+                        f"diverged at frontier {chunk[2]}"
+                    )
+            assert state == oracle_at(final)
+        early.close()
+        mid.close()
+
+    def test_as_of_subscribe_snapshots_at_exact_time(self, cluster):
+        coord = cluster()
+        coord.execute("CREATE TABLE ao (x BIGINT NOT NULL)")
+        coord.execute("INSERT INTO ao VALUES (1)")
+        t1 = coord._table_writers["ao"].upper - 1
+        coord.execute("INSERT INTO ao VALUES (2)")
+        sub = coord.execute(f"SUBSCRIBE ao AS OF {t1}").subscription
+        got = sub.poll(timeout=30)
+        assert got is not None
+        events, _f = got
+        # First delivery: the collapsed snapshot at exactly t1 (one
+        # row), bridged by the (2,) delta beyond it.
+        snap_rows = [e for e in events if e[-2] == t1]
+        assert [(e[0], e[-1]) for e in snap_rows] == [(1, 1)]
+        final = coord._table_writers["ao"].upper
+        state = _apply({}, [("deltas", events, sub.frontier, 0.0)])
+        state = _drain_until(sub, final, state=state)
+        assert state == {(1,): 1, (2,): 1}
+        sub.close()
+
+
+class TestExactlyOnceResume:
+    def test_resume_across_coordinator_restart(self, cluster):
+        """The durable-sink exactly-once claim, pinned: deliveries
+        before a coordinator restart plus a resumed session's
+        deliveries after it equal ONE exact replay of the shard —
+        no duplicated delta, no lost delta."""
+        coord = cluster()
+        coord.execute("CREATE TABLE src (x BIGINT NOT NULL)")
+        coord.execute(
+            "CREATE MATERIALIZED VIEW mv AS "
+            "SELECT x, count(*) FROM src GROUP BY x"
+        )
+        coord.execute("INSERT INTO src VALUES (1), (1), (2)")
+        sub = coord.execute("SUBSCRIBE mv").subscription
+        got = sub.poll(timeout=60)
+        assert got is not None
+        pre_events, pre_frontier = got
+        pre_state = _apply(
+            {}, [("deltas", pre_events, pre_frontier, 0.0)]
+        )
+        sub.close()
+        coord.shutdown()
+
+        coord2 = cluster()
+        coord2.execute("INSERT INTO src VALUES (2), (3)")
+        sub2 = coord2.subscribe_hub.resume("mv", pre_frontier)
+        mv_shard = coord2.catalog.items["mv"].definition["shard"]
+        import time as _t
+
+        deadline = _t.monotonic() + 90.0
+        # Wait for the MV to absorb the new write.
+        want = {(1, 2), (2, 2), (3, 1)}
+        state = dict(pre_state)
+        while True:
+            assert _t.monotonic() < deadline, state
+            if sub2.wait(1.0):
+                state = _apply(state, sub2.pop_ready())
+            if {k for k in state} == want and all(
+                n == 1 for n in state.values()
+            ):
+                break
+        # Authoritative replay: the whole shard from 0.
+        reader = coord2.persist.open_reader(mv_shard, "test-replay")
+        try:
+            upper = coord2.persist.machine(mv_shard).reload().upper
+            _s, cols, _n, _tm, diff = reader.snapshot(upper - 1)
+        finally:
+            reader.expire()
+        replay: dict = {}
+        for i in range(len(diff)):
+            key = tuple(int(c[i]) for c in cols)
+            replay[key] = replay.get(key, 0) + int(diff[i])
+        replay = {k: n for k, n in replay.items() if n}
+        assert state == replay
+        sub2.close()
+
+
+class TestBackpressure:
+    def test_admission_sheds_with_server_busy(self, cluster):
+        coord = cluster()
+        coord.execute("CREATE TABLE ad (x BIGINT NOT NULL)")
+        coord.execute("INSERT INTO ad VALUES (1)")
+        coord.update_config({"subscribe_max_sessions": 2})
+        s1 = coord.execute("SUBSCRIBE ad").subscription
+        s2 = coord.execute("SUBSCRIBE ad").subscription
+        with pytest.raises(ServerBusy):
+            coord.execute("SUBSCRIBE ad")
+        assert coord.subscribe_hub.stats["sheds"] == 1
+        s1.close()
+        # A freed slot admits again.
+        s3 = coord.execute("SUBSCRIBE ad").subscription
+        s2.close()
+        s3.close()
+
+    def test_slow_consumer_disconnect_policy(self, cluster):
+        coord = cluster()
+        coord.execute("CREATE TABLE sl (x BIGINT NOT NULL)")
+        coord.execute("INSERT INTO sl VALUES (0)")
+        sub = coord.execute("SUBSCRIBE sl").subscription
+        coord.update_config(
+            {
+                "subscribe_queue_depth": 3,
+                "subscribe_slow_policy": "disconnect",
+            }
+        )
+        # Never drain; pile up past the bound.
+        for i in range(12):
+            coord.execute(f"INSERT INTO sl VALUES ({i + 1})")
+        import time as _t
+
+        deadline = _t.monotonic() + 30.0
+        while sub.sheds == 0:
+            assert _t.monotonic() < deadline
+            _t.sleep(0.02)
+        with pytest.raises(SubscriptionLagging):
+            while True:
+                sub.pop_ready()
+                assert _t.monotonic() < deadline
+                _t.sleep(0.02)
+        assert sub.closed
+        # The hub reaped the session.
+        assert coord.subscribe_hub.session_count() == 0
+
+    def test_slow_consumer_coalesce_policy(self, cluster):
+        coord = cluster()
+        coord.execute("CREATE TABLE co (x BIGINT NOT NULL)")
+        coord.execute("INSERT INTO co VALUES (0)")
+        sub = coord.execute("SUBSCRIBE co").subscription
+        coord.update_config(
+            {
+                "subscribe_queue_depth": 3,
+                "subscribe_slow_policy": "coalesce",
+            }
+        )
+        for i in range(12):
+            coord.execute(f"INSERT INTO co VALUES ({i + 1})")
+        import time as _t
+
+        deadline = _t.monotonic() + 30.0
+        while sub.sheds == 0:
+            assert _t.monotonic() < deadline
+            _t.sleep(0.02)
+        final = coord._table_writers["co"].upper
+        state = _drain_until(sub, final)
+        # The coalesced snapshot is the exact current state — the
+        # dropped backlog was replaced by state transfer, not lost.
+        assert state == {(i,): 1 for i in range(13)}
+        assert sub.sheds >= 1
+        assert not sub.closed  # coalesce keeps the session alive
+        sub.close()
+
+
+class TestLifecycleAndSurfaces:
+    def test_drop_closes_tailing_sessions(self, cluster):
+        coord = cluster()
+        coord.execute("CREATE TABLE dr (x BIGINT NOT NULL)")
+        coord.execute("INSERT INTO dr VALUES (5)")
+        sub = coord.execute("SUBSCRIBE dr").subscription
+        _drain_until(sub, coord._table_writers["dr"].upper)
+        coord.execute("DROP TABLE dr")
+        import time as _t
+
+        deadline = _t.monotonic() + 10.0
+        while not sub.closed:
+            assert _t.monotonic() < deadline
+            _t.sleep(0.02)
+        assert coord.subscribe_hub.session_count() == 0
+        assert sub.poll(timeout=0.1) is None
+
+    def test_drop_of_source_closes_query_subscription(self, cluster):
+        """Dropping a TABLE a query subscription reads closes the
+        session AND drops the shared dataflow exactly once (its sink
+        would never advance again)."""
+        coord = cluster()
+        coord.execute("CREATE TABLE qd (x BIGINT NOT NULL)")
+        coord.execute("INSERT INTO qd VALUES (1)")
+        sub = coord.execute(
+            "SUBSCRIBE TO (SELECT x FROM qd WHERE x >= 0)"
+        ).subscription
+        _drain_until(sub, coord._table_writers["qd"].upper)
+        assert coord.subscribe_hub.stats["installs"] == 1
+        coord.execute("DROP TABLE qd")
+        import time as _t
+
+        deadline = _t.monotonic() + 10.0
+        while not sub.closed:
+            assert _t.monotonic() < deadline
+            _t.sleep(0.02)
+        assert coord.subscribe_hub.stats["drops"] == 1
+        with coord.controller._lock:
+            assert not any(
+                n.startswith("sub")
+                for n in coord.controller._dataflows
+            )
+
+    def test_shutdown_reaps_sessions_and_readers(self, cluster):
+        coord = cluster()
+        coord.execute("CREATE TABLE sh (x BIGINT NOT NULL)")
+        coord.execute("INSERT INTO sh VALUES (1)")
+        sql = "SUBSCRIBE TO (SELECT x FROM sh WHERE x >= 0)"
+        subs = [coord.execute(sql).subscription for _ in range(3)]
+        subs.append(coord.execute("SUBSCRIBE sh").subscription)
+        coord.shutdown()
+        assert all(s.closed for s in subs)
+        assert coord.subscribe_hub.session_count() == 0
+        for shard, machine in coord.persist._machines.items():
+            holds = [
+                r
+                for r, _s in machine.reload().reader_holds
+                if r.startswith("subtail-")
+            ]
+            assert not holds, (shard, holds)
+
+    def test_mz_subscriptions_and_explain_analysis(self, cluster):
+        coord = cluster()
+        coord.execute("CREATE TABLE mzs (x BIGINT NOT NULL)")
+        coord.execute("INSERT INTO mzs VALUES (1)")
+        empty = coord.execute(
+            "SELECT count(*) FROM mz_subscriptions"
+        ).rows
+        assert empty == [(0,)]
+        s1 = coord.execute("SUBSCRIBE mzs").subscription
+        s2 = coord.execute("SUBSCRIBE mzs").subscription
+        _drain_until(s1, coord._table_writers["mzs"].upper)
+        res = coord.execute(
+            "SELECT session, dataflow, sharers FROM mz_subscriptions"
+        )
+        assert len(res.rows) == 2
+        assert all(r[1] == "mzs" and r[2] == 2 for r in res.rows)
+        # Delivered/frontier reflect progress for the drained session.
+        res = coord.execute(
+            "SELECT session, delivered FROM mz_subscriptions"
+        )
+        by_sid = {int(r[0]): int(r[1]) for r in res.rows}
+        assert by_sid[s1.session_id] >= 1
+        txt = coord.execute("EXPLAIN ANALYSIS SELECT x FROM mzs").text
+        assert "subscriptions:" in txt
+        assert "sessions=2" in txt
+        assert "readbacks_per_span" in txt
+        s1.close()
+        s2.close()
+        txt = coord.execute("EXPLAIN ANALYSIS SELECT x FROM mzs").text
+        assert "(no active subscriptions)" in txt
+
+    def test_metrics_registered(self, cluster):
+        coord = cluster()
+        coord.execute("CREATE TABLE mt2 (x BIGINT NOT NULL)")
+        sub = coord.execute("SUBSCRIBE mt2").subscription
+        from materialize_tpu.utils.metrics import REGISTRY
+
+        text = REGISTRY.expose_text()
+        assert "mz_subscribe_sessions_total" in text
+        assert "mz_subscribe_readbacks_total" in text
+        sub.close()
+
+
+class TestWireErrorSurfacing:
+    def test_pgwire_slow_consumer_gets_53400_not_clean_eof(
+        self, cluster
+    ):
+        """Review regression: when the TAIL thread reaps a lagging
+        session (disconnect policy), the pgwire COPY-out loop must
+        still surface the retryable 53400 error to the client — a
+        clean end-of-stream would silently lose every delta after the
+        overflow."""
+        import struct
+        import time as _t
+
+        from materialize_tpu.server.pgwire import PgServer
+        from materialize_tpu.testing.chaos import _pg_subscribe
+
+        coord = cluster()
+        pg = PgServer(coord).start()
+        try:
+            coord.execute("CREATE TABLE wv (x BIGINT NOT NULL)")
+            coord.execute("INSERT INTO wv VALUES (0)")
+            coord.update_config(
+                {
+                    "subscribe_queue_depth": 2,
+                    "subscribe_slow_policy": "disconnect",
+                }
+            )
+            # A client that stops reading after the CopyOutResponse.
+            sock = _pg_subscribe(pg.port, "SUBSCRIBE wv")
+            for i in range(12):
+                coord.execute(f"INSERT INTO wv VALUES ({i + 1})")
+            deadline = _t.monotonic() + 30.0
+            while coord.subscribe_hub.session_count():
+                assert _t.monotonic() < deadline
+                _t.sleep(0.02)
+            # Now read what the server sent: CopyData frames, then an
+            # ErrorResponse carrying SQLSTATE 53400.
+            sock.settimeout(10.0)
+            code = None
+            while code is None:
+                tag = sock.recv(1)
+                assert tag, "clean EOF without the 53400 error"
+                (n,) = struct.unpack("!I", sock.recv(4))
+                data = b""
+                while len(data) < n - 4:
+                    data += sock.recv(n - 4 - len(data))
+                if tag == b"E":
+                    for f in data.split(b"\x00"):
+                        if f[:1] == b"C":
+                            code = f[1:].decode()
+            assert code == "53400", code
+            sock.close()
+        finally:
+            pg.stop()
+
+    def test_http_subscribe_never_executes_non_subscribe(
+        self, cluster
+    ):
+        """Review regression: /api/subscribe must validate BEFORE
+        executing — a GET carrying an INSERT must not commit the
+        write and then report 400 (hub-level check: the statement is
+        rejected at parse time, so the coordinator never runs it)."""
+        from materialize_tpu.server.http import HttpServer
+
+        coord = cluster()
+        http = HttpServer(coord).start()
+        try:
+            coord.execute("CREATE TABLE nx (x BIGINT NOT NULL)")
+            import urllib.error
+            import urllib.parse
+            import urllib.request
+
+            url = (
+                f"http://127.0.0.1:{http.port}/api/subscribe?query="
+                + urllib.parse.quote("INSERT INTO nx VALUES (1)")
+            )
+            try:
+                urllib.request.urlopen(url, timeout=10)
+                assert False, "expected HTTP 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            # The write must NOT have happened.
+            assert coord.execute(
+                "SELECT count(*) FROM nx"
+            ).rows == [(0,)]
+        finally:
+            http.stop()
+
+
+@pytest.mark.chaos
+class TestSubscriberChaos:
+    def test_subscriber_storm_no_leaks(self, tmp_path):
+        """ISSUE 11 satellite: clients die abruptly mid-storm (raw
+        socket hard-close incl. one mid-snapshot, session closes)
+        under insert/retraction churn; survivors reconstruct the
+        exact oracle; afterwards zero dataflows, tails, sessions, or
+        persist readers leak, and installs == drops."""
+        from materialize_tpu.testing.chaos import run_subscriber_storm
+
+        rep = run_subscriber_storm(
+            str(tmp_path / "storm"),
+            seed=3,
+            ticks=16,
+            subscribers=8,
+            kills=3,
+            pgwire_clients=2,
+        )
+        assert rep.ok, rep.failures
+        assert rep.installs == 1
+        assert rep.killed_sessions + rep.killed_sockets >= 2
+
+    @pytest.mark.slow
+    def test_subscriber_storm_sigkill_clients(self, tmp_path):
+        from materialize_tpu.testing.chaos import (
+            run_subscriber_storm,
+            subprocess_available,
+        )
+
+        if not subprocess_available():
+            pytest.skip("no subprocess support on this host")
+        rep = run_subscriber_storm(
+            str(tmp_path / "storm"),
+            seed=11,
+            ticks=24,
+            subscribers=10,
+            kills=4,
+            pgwire_clients=3,
+            sigkill_clients=2,
+        )
+        assert rep.ok, rep.failures
