@@ -73,6 +73,27 @@ DEFAULT_HOT_PATH = (
     ),
 )
 
+# The observability recorder path (ISSUE 12): trace-span recording,
+# the compile-ledger wrapper around every jitted dispatch, and the
+# span-commit cadence records all sit ON the per-span hot path — they
+# must be pure host bookkeeping (no d2h reads, no blocking). Linted by
+# the same host-sync gate as the dispatch path itself.
+RECORDER_PATH = (
+    ("materialize_tpu.utils.trace", "Tracer.record"),
+    ("materialize_tpu.utils.trace", "Tracer._append"),
+    ("materialize_tpu.utils.trace", "Tracer.span"),
+    ("materialize_tpu.utils.compile_ledger", "LedgeredJit.__call__"),
+    ("materialize_tpu.utils.compile_ledger", "CompileLedger.record"),
+    ("materialize_tpu.utils.compile_ledger", "tier_vector"),
+    (
+        "materialize_tpu.storage.persist.operators",
+        "MaintainedView._commit_span",
+    ),
+    ("materialize_tpu.render.span_exec", "SpanExecutor._complete"),
+)
+
+DEFAULT_HOT_PATH = DEFAULT_HOT_PATH + RECORDER_PATH
+
 
 def _resolve(module_path: str, qualname: str):
     import importlib

@@ -62,6 +62,20 @@ from ..repr.batch import Batch, capacity_tier
 from ..repr.schema import Schema
 
 
+def device_nbytes(tree) -> int:
+    """Total bytes of the DEVICE-resident array leaves of a pytree
+    (host numpy mirrors excluded): shape * itemsize from the aval —
+    pure metadata, never a device read or sync."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            try:
+                total += leaf.size * leaf.dtype.itemsize
+            except (AttributeError, TypeError):
+                pass
+    return total
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class Arrangement:
@@ -388,6 +402,17 @@ class Spine:
             self.lanes, self.slot_lanes,
         )
 
+    def device_bytes(self) -> dict:
+        """Device-resident bytes per spine component (ISSUE 12: the
+        mz_arrangement_sizes byte columns): the run ladder, the
+        append-slot ingest ring (+cursor), and the cached sort lanes.
+        Pure metadata — shape*itemsize off the avals, no device read."""
+        return {
+            "runs": device_nbytes(self.runs_b),
+            "slots": device_nbytes((self.slots, self.cursor)),
+            "lanes": device_nbytes((self.lanes, self.slot_lanes)),
+        }
+
     def runs(self) -> tuple:
         """Single-run Arrangement views for lookup/probe code (base
         first, then progressively smaller runs, then ingest slots),
@@ -677,10 +702,15 @@ def clone_state_tree(tree):
     references into the carry; it holds this clone instead. jit
     outputs never alias un-donated inputs, so every returned leaf is a
     fresh buffer."""
+    from ..utils.compile_ledger import ledger_jit
+
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     jitfn = _CLONE_JITS.get(len(leaves))
     if jitfn is None:
-        jitfn = jax.jit(lambda *ls: tuple(jnp.copy(l) for l in ls))
+        jitfn = ledger_jit(
+            jax.jit(lambda *ls: tuple(jnp.copy(l) for l in ls)),
+            "clone", "spine", f"clone:{len(leaves)}",
+        )
         _CLONE_JITS[len(leaves)] = jitfn
     return jax.tree_util.tree_unflatten(treedef, jitfn(*leaves))
 

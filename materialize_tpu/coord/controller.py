@@ -70,12 +70,19 @@ class _PeekWaiter:
 
     __slots__ = (
         "probe", "as_of", "event", "rows", "served_at", "error",
-        "retryable",
+        "retryable", "trace",
     )
 
     def __init__(self, probe: tuple, as_of: int):
+        from ..utils.trace import TRACER
+
         self.probe = probe
         self.as_of = as_of
+        # Statement trace context (ISSUE 12): captured on the SESSION
+        # thread so the batched dispatch (possibly on the flusher
+        # thread) can still ship a context the replica's serve span
+        # joins — one tree per statement even through batching.
+        self.trace = TRACER.context()
         ev = getattr(_WAITER_TLS, "event", None)
         if ev is None:
             ev = threading.Event()
@@ -311,8 +318,13 @@ class PeekBatcher:
             self.stats["max_batch"] = max(
                 self.stats["max_batch"], len(waiters)
             )
+        # A batch serves N sessions' statements; the shipped context is
+        # the FIRST traced waiter's (a replica span can join one tree).
+        trace = next(
+            (w.trace for w in waiters if w.trace is not None), None
+        )
         ctrl._broadcast(
-            ctp.peek_lookup(peek_id, dataflow, as_of, spec)
+            ctp.peek_lookup(peek_id, dataflow, as_of, spec, trace=trace)
         )
         return _PeekBatch(peek_id, ev, waiters, scan)
 
@@ -539,6 +551,15 @@ class ComputeController:
         # fingerprint-unchanged dataflow across a controller restart
         # is THE counted reconciliation invariant (mz_recovery).
         self.recovery_stats: dict[str, dict[str, dict]] = {}
+        # Observability piggybacks (ISSUE 12): per-dataflow device-
+        # resident bytes by spine component (df -> replica -> dict) and
+        # each replica's latest /metrics sample snapshot (replica ->
+        # families list, utils/metrics.py) — the deployment-wide
+        # mz_arrangement_sizes and /metrics surfaces. Trace spans and
+        # compile records ingest straight into the process-global
+        # TRACER / LEDGER (pid-deduped), not controller state.
+        self.arrangement_bytes: dict[str, dict[str, dict]] = {}
+        self.replica_metrics: dict[str, list] = {}
         self.statuses: deque = deque(maxlen=1000)  # replica error reports
         # Install acks: df name -> replica -> error string | None (ok).
         self.install_acks: dict[str, dict] = {}
@@ -603,6 +624,9 @@ class ComputeController:
                 per_df.pop(name, None)
             for per_df in self.recovery_stats.values():
                 per_df.pop(name, None)
+            for per_df in self.arrangement_bytes.values():
+                per_df.pop(name, None)
+            self.replica_metrics.pop(name, None)
 
     def _history_snapshot(self):
         with self._lock:
@@ -618,11 +642,19 @@ class ComputeController:
 
     # -- commands -------------------------------------------------------------
     def create_dataflow(self, desc: DataflowDescription) -> None:
+        from ..utils.trace import TRACER
+
+        # History keeps the UNTRACED command: a reconnect replay must
+        # not attribute reinstall spans to the original DDL statement.
         cmd = ctp.create_dataflow(desc)
         with self._lock:
             self._dataflows[desc.name] = cmd
             self.install_acks.pop(desc.name, None)
-        self._broadcast(cmd)
+        with TRACER.span("controller.create_dataflow",
+                         dataflow=desc.name):
+            self._broadcast(
+                ctp.create_dataflow(desc, trace=TRACER.context())
+            )
 
     def wait_installed(
         self, name: str, timeout: float | None = None
@@ -671,6 +703,7 @@ class ComputeController:
             self.donation_verdicts.pop(name, None)
             self.sharding_verdicts.pop(name, None)
             self.recovery_stats.pop(name, None)
+            self.arrangement_bytes.pop(name, None)
             self.install_acks.pop(name, None)
         self._broadcast(ctp.drop_dataflow(name))
 
@@ -688,32 +721,44 @@ class ComputeController:
     ):
         """Peek on every replica; first response wins
         (absorb_peek_response). Returns (rows, served_at)."""
+        from ..utils.trace import TRACER
+
         peek_id = next(self._peek_counter)
         ev = threading.Event()
         self._peek_events[peek_id] = ev
-        self._broadcast(ctp.peek(peek_id, dataflow, as_of, exact))
-        try:
-            if not ev.wait(timeout):
-                # Retryable by contract (ISSUE 10 satellite): the front
-                # ends shed this as ServerBusy (53400 / 503), and the
-                # sequencing lock was released around the wait, so a
-                # timed-out peek never poisons later statements.
-                raise PeekTimedOut(
-                    f"server busy: peek {peek_id} on {dataflow!r} "
-                    "timed out; retry"
+        with TRACER.span(
+            "controller.peek", dataflow=dataflow, peek_id=peek_id
+        ):
+            self._broadcast(
+                ctp.peek(
+                    peek_id, dataflow, as_of, exact,
+                    trace=TRACER.context(),
                 )
-            with self._lock:
-                resp = self._peek_results.pop(peek_id)
-            if "error" in resp:
-                raise RuntimeError(resp["error"])
-            return resp["rows"], resp["served_at"]
-        finally:
-            # Event first, then any straggler result, both under the
-            # absorber's lock: later duplicate responses cannot leak.
-            with self._lock:
-                self._peek_events.pop(peek_id, None)
-                self._peek_results.pop(peek_id, None)
-            self._broadcast(ctp.cancel_peek(peek_id))
+            )
+            try:
+                if not ev.wait(timeout):
+                    # Retryable by contract (ISSUE 10 satellite): the
+                    # front ends shed this as ServerBusy (53400 / 503),
+                    # and the sequencing lock was released around the
+                    # wait, so a timed-out peek never poisons later
+                    # statements.
+                    raise PeekTimedOut(
+                        f"server busy: peek {peek_id} on {dataflow!r} "
+                        "timed out; retry"
+                    )
+                with self._lock:
+                    resp = self._peek_results.pop(peek_id)
+                if "error" in resp:
+                    raise RuntimeError(resp["error"])
+                return resp["rows"], resp["served_at"]
+            finally:
+                # Event first, then any straggler result, both under
+                # the absorber's lock: later duplicate responses cannot
+                # leak.
+                with self._lock:
+                    self._peek_events.pop(peek_id, None)
+                    self._peek_results.pop(peek_id, None)
+                self._broadcast(ctp.cancel_peek(peek_id))
 
     def peek_lookup(
         self,
@@ -729,10 +774,13 @@ class ComputeController:
         of one stacked device gather, first replica response wins.
         Returns (rows, served_at); raises ServerBusy when admission
         control sheds the read."""
-        return self._peek_batcher.submit(
-            dataflow, tuple(bound_cols), bool(scan), tuple(probe),
-            int(as_of), timeout,
-        )
+        from ..utils.trace import TRACER
+
+        with TRACER.span("controller.peek_lookup", dataflow=dataflow):
+            return self._peek_batcher.submit(
+                dataflow, tuple(bound_cols), bool(scan), tuple(probe),
+                int(as_of), timeout,
+            )
 
     def peek_stats(self) -> dict:
         """Read-plane observability: lookups, batches, occupancy,
@@ -779,6 +827,31 @@ class ComputeController:
                             self.recovery_stats.setdefault(df, {})[
                                 replica
                             ] = v
+                        for df, v in msg.get(
+                            "arrangement_bytes", {}
+                        ).items():
+                            self.arrangement_bytes.setdefault(df, {})[
+                                replica
+                            ] = v
+                        if "metrics" in msg:
+                            self.replica_metrics[replica] = msg[
+                                "metrics"
+                            ]
+                # Trace spans and compile records merge into the
+                # process-global rings OUTSIDE the controller lock
+                # (ingest has its own; pid-dedupe makes in-process
+                # replicas — which share the rings — a no-op).
+                if replica in self.replicas:
+                    spans = msg.get("spans")
+                    if spans:
+                        from ..utils.trace import TRACER
+
+                        TRACER.ingest(spans, process=replica)
+                    compiles = msg.get("compiles")
+                    if compiles:
+                        from ..utils.compile_ledger import LEDGER
+
+                        LEDGER.ingest(compiles, process=replica)
             elif kind == "Status":
                 with self._lock:
                     self.statuses.append(msg)

@@ -178,6 +178,25 @@ class Coordinator:
         # retracts the prior override in O(1) instead of re-reading
         # the whole catalog shard under the sequencing lock.
         self._dyncfg_records: dict[str, dict] = {}
+        # Slow-statement log (ISSUE 12): statements over the
+        # slow_statement_ms dyncfg threshold, bounded ring, served by
+        # the mz_slow_statements introspection relation.
+        from collections import deque as _deque
+
+        from ..utils.metrics import REGISTRY as _REGISTRY
+
+        self.slow_statements: _deque = _deque(maxlen=256)
+        self._slow_statement_counter = _REGISTRY.get_or_create(
+            "counter", "mz_slow_statements_total",
+            "statements exceeding the slow_statement_ms threshold",
+        )
+        # Label this process's span recorder: merged trace trees show
+        # WHERE each span ran (replica processes label theirs in
+        # coord/replica.main).
+        from ..utils.trace import TRACER as _TRACER
+
+        if _TRACER.process.startswith("pid"):
+            _TRACER.process = "coordinator"
         t0 = _time.monotonic()
         self._bootstrap()
         self.recovery["recovery_ms"] = (_time.monotonic() - t0) * 1e3
@@ -352,6 +371,43 @@ class Coordinator:
                 )
         return "\n".join(lines)
 
+    def _compile_analysis_text(self) -> str:
+        """The compile ledger's EXPLAIN ANALYSIS block (ISSUE 12):
+        per-kind compile counts and total wall seconds, SCOPED to the
+        currently installed catalog-named dataflows (the donation-block
+        coverage discipline — transient SELECT installs carry
+        session-scoped generated names that would make EXPLAIN output
+        nondeterministic; mz_compile_log serves EVERY record
+        relationally). `hit` seconds are the wall a cross-process
+        program bank (ROADMAP 4) would recover."""
+        from ..utils.compile_ledger import LEDGER
+
+        named = {it.name for it in self.catalog.items.values()}
+        named |= set(self.peekable.values())
+        with self.controller._lock:
+            installed = {
+                n for n in self.controller._dataflows if n in named
+            }
+        s = LEDGER.summary(names=installed)
+        lines = ["compiles:"]
+        if not s["compiles"]:
+            lines.append("  (no compiles recorded for installed "
+                         "dataflows)")
+            return "\n".join(lines)
+        for kind in sorted(s["by_kind"]):
+            k = s["by_kind"][kind]
+            lines.append(
+                f"  {kind}: compiles={k['compiles']} "
+                f"seconds={k['seconds']:.3f}"
+            )
+        lines.append(
+            f"  total: compiles={s['compiles']} "
+            f"misses={s['misses']} hits={s['hits']} "
+            f"seconds={s['seconds']:.3f} "
+            f"bankable_seconds={s['hit_seconds']:.3f}"
+        )
+        return "\n".join(lines)
+
     # -- durable catalog ----------------------------------------------------
     def _catalog_append(self, record: dict, diff: int) -> None:
         self._net_durable += 1 if diff > 0 else -1
@@ -418,6 +474,44 @@ class Coordinator:
 
     # -- statement execution -------------------------------------------------
     def execute(self, sql: str) -> ExecuteResult:
+        """One statement, sequenced. Opens the coordinator's span of
+        the statement trace (child of the front end's root span when
+        one is open on this thread; a root of its own for programmatic
+        callers) and feeds the slow-statement log (ISSUE 12)."""
+        from ..utils.trace import TRACER
+
+        t0 = _time.perf_counter()
+        with TRACER.span("coord.execute", sql=sql[:100]):
+            try:
+                return self._execute_inner(sql)
+            finally:
+                self._note_statement(
+                    sql, (_time.perf_counter() - t0) * 1e3,
+                    TRACER.current_trace(),
+                )
+
+    def _note_statement(
+        self, sql: str, ms: float, trace_id: int
+    ) -> None:
+        """Slow-statement log (dyncfg-gated): statements over the
+        slow_statement_ms threshold land in a bounded ring served by
+        mz_slow_statements and count in /metrics."""
+        from ..utils.dyncfg import SLOW_STATEMENT_MS
+
+        thresh = float(SLOW_STATEMENT_MS(COMPUTE_CONFIGS))
+        if thresh <= 0 or ms < thresh:
+            return
+        self.slow_statements.append(
+            {
+                "sql": sql.strip()[:500],
+                "ms": round(ms, 3),
+                "trace_id": int(trace_id or 0),
+                "at": _time.time(),
+            }
+        )
+        self._slow_statement_counter.inc()
+
+    def _execute_inner(self, sql: str) -> ExecuteResult:
         from ..repr.schema import DictExhausted
 
         with self._lock:
@@ -501,6 +595,14 @@ class Coordinator:
                     from ..utils.retry import RetryPolicy
 
                     RetryPolicy.parse(plan.value)
+                if plan.name == "trace_level" and plan.value is not None:
+                    # None = SET ... DEFAULT (reset): always legal.
+                    from ..utils.trace import LEVELS
+
+                    if str(plan.value) not in LEVELS:
+                        raise ValueError(
+                            f"expected one of {sorted(LEVELS)}"
+                        )
                 self.update_config({plan.name: plan.value})
             except (TypeError, ValueError) as e:
                 raise PlanError(
@@ -573,6 +675,8 @@ class Coordinator:
                     + self._sharding_analysis_text()
                     + "\n"
                     + self._recovery_analysis_text()
+                    + "\n"
+                    + self._compile_analysis_text()
                     + "\n"
                     + self.subscribe_hub.analysis_text()
                 )
@@ -1897,6 +2001,19 @@ class Coordinator:
         replicas and reconnect replay stays faithful — a full override
         map would silently drop resets."""
         COMPUTE_CONFIGS.update(values)
+        if "trace_level" in values:
+            # The trace_level dyncfg drives this process's span
+            # recorder (ISSUE 12); replicas flip theirs when the
+            # UpdateConfiguration command reaches them.
+            from ..utils.trace import LEVELS, TRACER
+
+            lvl = values["trace_level"]
+            if lvl is None:
+                from ..utils.dyncfg import TRACE_LEVEL
+
+                lvl = TRACE_LEVEL.default
+            if lvl in LEVELS:
+                TRACER.set_level(lvl)
         self.controller.update_configuration(dict(values))
 
     def shutdown(self) -> None:

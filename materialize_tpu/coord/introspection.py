@@ -39,7 +39,19 @@ INTROSPECTION_SCHEMAS: dict[str, Schema] = {
         [Column("dataflow", S), Column("replica", S), Column("upper", I)]
     ),
     "mz_arrangement_sizes": Schema(
-        [Column("dataflow", S), Column("replica", S), Column("records", I)]
+        [
+            Column("dataflow", S),
+            Column("replica", S),
+            Column("records", I),
+            # Device-resident bytes per spine component (ISSUE 12):
+            # the run ladder, the append-slot ingest ring, the cached
+            # sort lanes, and the multiversion history window.
+            Column("bytes", I),
+            Column("runs_bytes", I),
+            Column("slots_bytes", I),
+            Column("lanes_bytes", I),
+            Column("history_bytes", I),
+        ]
     ),
     "mz_span_epochs": Schema(
         [
@@ -98,9 +110,42 @@ INTROSPECTION_SCHEMAS: dict[str, Schema] = {
     ),
     "mz_trace_spans": Schema(
         [
+            # The statement trace tree (ISSUE 12): one trace_id per
+            # statement; spans from every process (pgwire/coordinator/
+            # controller locally, replicas via the Frontiers
+            # piggyback) share the id space, parent_id links the tree
+            # across the CTP boundary. parent_id 0 = root.
+            Column("trace_id", I),
+            Column("span_id", I),
+            Column("parent_id", I),
+            Column("process", S),
             Column("name", S),
             Column("level", S),
+            Column("start_us", I),
             Column("duration_us", I),
+        ]
+    ),
+    "mz_compile_log": Schema(
+        [
+            # Every XLA compile anywhere in the deployment (ISSUE 12):
+            # program kind, owning dataflow, render fingerprint, tier
+            # vector, wall seconds, and whether the (kind,
+            # fingerprint, tier) key was seen before ("hit" = the
+            # recompile a program bank would have served).
+            Column("process", S),
+            Column("kind", S),
+            Column("dataflow", S),
+            Column("fingerprint", S),
+            Column("tier", S),
+            Column("seconds", F),
+            Column("cache", S),
+        ]
+    ),
+    "mz_slow_statements": Schema(
+        [
+            Column("sql", S),
+            Column("ms", F),
+            Column("trace_id", I),
         ]
     ),
     "mz_cluster_replicas": Schema(
@@ -159,11 +204,22 @@ def snapshot(coord, name: str) -> list[tuple]:
                 df: dict(per)
                 for df, per in coord.controller.arrangement_records.items()
             }
-        return [
-            (_enc(df), _enc(rep), n)
-            for df, per in sorted(snap.items())
-            for rep, n in sorted(per.items())
-        ]
+            bsnap = {
+                df: dict(per)
+                for df, per in coord.controller.arrangement_bytes.items()
+            }
+        rows = []
+        for df, per in sorted(snap.items()):
+            for rep, n in sorted(per.items()):
+                b = bsnap.get(df, {}).get(rep, {})
+                comp = [
+                    int(b.get(k, 0))
+                    for k in ("runs", "slots", "lanes", "history")
+                ]
+                rows.append(
+                    (_enc(df), _enc(rep), n, sum(comp), *comp)
+                )
+        return rows
     if name == "mz_span_epochs":
         # The pipelined control plane's committed span boundaries
         # (ISSUE 7): per (dataflow, replica), the monotone span-epoch
@@ -300,26 +356,74 @@ def snapshot(coord, name: str) -> list[tuple]:
     if name == "mz_metrics":
         from ..utils.metrics import REGISTRY
 
+        def full_name(sname, labels):
+            return sname + (
+                "{" + ",".join(
+                    f"{k}={v}" for k, v in sorted(labels.items())
+                ) + "}"
+                if labels
+                else ""
+            )
+
         rows = []
         with REGISTRY._lock:  # copy: registration may race iteration
             metrics = list(REGISTRY._metrics.values())
         for m in sorted(metrics, key=lambda m: m.name):
             for sname, labels, value in m.samples():
-                full = sname + (
-                    "{" + ",".join(
-                        f"{k}={v}" for k, v in sorted(labels.items())
-                    ) + "}"
-                    if labels
-                    else ""
-                )
-                rows.append((_enc(full), float(value)))
+                rows.append((_enc(full_name(sname, labels)),
+                             float(value)))
+        # Deployment-wide half (ISSUE 12): every replica's last
+        # piggybacked snapshot, labeled replica=<name> — one relation
+        # covers the cluster, like the merged /metrics scrape.
+        with coord.controller._lock:
+            remote = dict(coord.controller.replica_metrics)
+        for rep in sorted(remote):
+            for _fam, _kind, _help, samples in remote[rep]:
+                for sname, labels, value in samples:
+                    rows.append(
+                        (
+                            _enc(full_name(
+                                sname, {**labels, "replica": rep}
+                            )),
+                            float(value),
+                        )
+                    )
         return rows
     if name == "mz_trace_spans":
         from ..utils.trace import TRACER
 
         return [
-            (_enc(r.name), _enc(r.level), int(r.duration * 1e6))
+            (
+                int(r.trace_id),
+                int(r.span_id),
+                int(r.parent_id or 0),
+                _enc(r.process),
+                _enc(r.name),
+                _enc(r.level),
+                int(r.start * 1e6),
+                int(r.duration * 1e6),
+            )
             for r in TRACER.records()
+        ]
+    if name == "mz_compile_log":
+        from ..utils.compile_ledger import LEDGER
+
+        return [
+            (
+                _enc(r.process),
+                _enc(r.kind),
+                _enc(r.name),
+                _enc(r.fingerprint),
+                _enc(r.tier),
+                float(r.seconds),
+                _enc(r.cache),
+            )
+            for r in LEDGER.records()
+        ]
+    if name == "mz_slow_statements":
+        return [
+            (_enc(s["sql"]), float(s["ms"]), int(s["trace_id"]))
+            for s in list(coord.slow_statements)
         ]
     if name == "mz_cluster_replicas":
         return [

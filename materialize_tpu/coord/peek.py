@@ -76,6 +76,19 @@ def _peek_jits(df) -> dict:
     return df.__dict__.setdefault("_peek_jit_cache", {})
 
 
+def _peek_jit(df, kind: str, fn):
+    """Ledger-wrapped peek program (ISSUE 12): gather-program compiles
+    join mz_compile_log like every step/span program."""
+    from ..utils.compile_ledger import ledger_jit
+
+    import jax
+
+    return ledger_jit(
+        jax.jit(fn), kind, getattr(df, "name", "peek"),
+        getattr(df, "_fingerprint", getattr(df, "name", "peek")),
+    )
+
+
 # ---------------------------------------------------------------------------
 # device cores (traced per spine shape; shared with the census tooling)
 # ---------------------------------------------------------------------------
@@ -268,7 +281,7 @@ def _scan_rows(df) -> list:
     jits = _peek_jits(df)
     fn = jits.get("scan")
     if fn is None:
-        fn = jax.jit(_scan_core)
+        fn = _peek_jit(df, "peek_scan", _scan_core)
         jits["scan"] = fn
     cols, nulls, time, diff, valid = fn(df.output)
     mask = np.asarray(valid)
@@ -304,7 +317,9 @@ def _lookup_groups(df, bound_cols: tuple, probes: list) -> list:
         key = ("lookup", bound_cols, B, span)
         fn = jits.get(key)
         if fn is None:
-            fn = jax.jit(_make_lookup_core(bound_cols, span))
+            fn = _peek_jit(
+                df, "peek_lookup", _make_lookup_core(bound_cols, span)
+            )
             jits[key] = fn
         cols, nulls, time, diff, cnt = fn(df.output, arrays, ok)
         cnt = np.asarray(cnt)
@@ -363,7 +378,9 @@ def _point_groups(df, bound_cols: tuple, probes: list, served_t: int):
         key = ("point", B, span)
         fn = jits.get(key)
         if fn is None:
-            fn = jax.jit(_make_point_core(schema, span))
+            fn = _peek_jit(
+                df, "peek_point", _make_point_core(schema, span)
+            )
             jits[key] = fn
         net, need = fn(df.output, arrays, ok)
         need = np.asarray(need)

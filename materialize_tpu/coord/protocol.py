@@ -160,8 +160,21 @@ def hello(nonce: int) -> dict:
     return {"kind": "Hello", "nonce": nonce}
 
 
-def create_dataflow(desc: DataflowDescription) -> dict:
-    return {"kind": "CreateDataflow", "desc": desc}
+def with_trace(cmd: dict, trace: dict | None) -> dict:
+    """Attach a statement trace context (``{"t": trace_id, "s":
+    span_id}``, utils/trace.py) to a command so the replica's child
+    spans join the SAME tree (the OpenTelemetryContext-riding-commands
+    pattern, ISSUE 12). None is a no-op — replayed history and
+    untraced paths ship no context."""
+    if trace:
+        cmd["trace"] = trace
+    return cmd
+
+
+def create_dataflow(
+    desc: DataflowDescription, trace: dict | None = None
+) -> dict:
+    return with_trace({"kind": "CreateDataflow", "desc": desc}, trace)
 
 
 def drop_dataflow(name: str) -> dict:
@@ -169,19 +182,24 @@ def drop_dataflow(name: str) -> dict:
 
 
 def peek(
-    peek_id: int, dataflow: str, as_of: int | None, exact: bool = False
+    peek_id: int, dataflow: str, as_of: int | None, exact: bool = False,
+    trace: dict | None = None,
 ) -> dict:
     """``exact`` = serve at exactly ``as_of`` (AS OF semantics: rewind
     inside the multiversion window); default serves the latest complete
     result once the frontier passes ``as_of``."""
-    return {
-        "kind": "Peek", "peek_id": peek_id, "dataflow": dataflow,
-        "as_of": as_of, "exact": exact,
-    }
+    return with_trace(
+        {
+            "kind": "Peek", "peek_id": peek_id, "dataflow": dataflow,
+            "as_of": as_of, "exact": exact,
+        },
+        trace,
+    )
 
 
 def peek_lookup(
-    peek_id: int, dataflow: str, as_of: int | None, spec: dict
+    peek_id: int, dataflow: str, as_of: int | None, spec: dict,
+    trace: dict | None = None,
 ) -> dict:
     """A BATCHED fast-path peek (coord/peek.py): ``spec`` carries
     {"scan": bool, "bound_cols": tuple, "probes": [...]} — N sessions'
@@ -189,10 +207,13 @@ def peek_lookup(
     device gather once the dataflow's frontier passes ``as_of``. The
     response's ``rows_groups`` aligns with ``probes`` (one shared group
     for scans)."""
-    return {
-        "kind": "Peek", "peek_id": peek_id, "dataflow": dataflow,
-        "as_of": as_of, "exact": False, "lookup": spec,
-    }
+    return with_trace(
+        {
+            "kind": "Peek", "peek_id": peek_id, "dataflow": dataflow,
+            "as_of": as_of, "exact": False, "lookup": spec,
+        },
+        trace,
+    )
 
 
 def cancel_peek(peek_id: int) -> dict:
@@ -215,6 +236,10 @@ def frontiers(
     donation: dict | None = None,
     sharding: dict | None = None,
     recovery: dict | None = None,
+    spans: list | None = None,
+    compiles: list | None = None,
+    metrics: list | None = None,
+    arrangement_bytes: dict | None = None,
 ) -> dict:
     """Replica -> controller frontier report. ``span_epochs`` carries
     each dataflow's monotone COMMITTED span counter (ISSUE 7: the
@@ -231,7 +256,15 @@ def frontiers(
     piggybacks each dataflow's install/rebuild/reconcile counters
     (ISSUE 10) whenever they change — the mz_recovery surface that
     makes reconciliation a counted invariant (rebuilds == 0 across a
-    controller restart with unchanged fingerprints)."""
+    controller restart with unchanged fingerprints). ``spans`` /
+    ``compiles`` / ``metrics`` piggyback the observability plane
+    (ISSUE 12): completed trace spans (wire tuples, utils/trace.py),
+    compile-ledger records (utils/compile_ledger.py), and the
+    replica's /metrics sample families — each shipped only when
+    nonempty/changed, so steady state with tracing off pays nothing.
+    ``arrangement_bytes`` carries per-dataflow device-resident bytes
+    by spine component (runs/slots/lanes/history) alongside the row
+    counts in ``records`` — the mz_arrangement_sizes surface."""
     msg = {
         "kind": "Frontiers",
         "uppers": uppers,
@@ -245,4 +278,12 @@ def frontiers(
         msg["sharding"] = sharding
     if recovery:
         msg["recovery"] = recovery
+    if spans:
+        msg["spans"] = spans
+    if compiles:
+        msg["compiles"] = compiles
+    if metrics:
+        msg["metrics"] = metrics
+    if arrangement_bytes:
+        msg["arrangement_bytes"] = arrangement_bytes
     return msg

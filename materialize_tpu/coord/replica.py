@@ -82,6 +82,7 @@ class ReplicaWorker:
         persist_client: PersistClient | None = None,
         replica_id: str = "r0",
         workers: int = 1,
+        ship_observability: bool = False,
     ):
         if persist_client is not None:
             self.client = persist_client
@@ -121,6 +122,25 @@ class ReplicaWorker:
         # rebuilds == 0 — reconciliation as a counted invariant.
         self._recovery: dict[str, dict] = {}
         self._recovery_dirty: set = set()
+        # Observability piggybacks (ISSUE 12): completed trace spans
+        # and compile-ledger records queue for the Frontiers report;
+        # /metrics snapshots ship on a throttle, only when changed.
+        # Shipping is enabled only for SUBPROCESS replicas
+        # (ship_observability, set by the `-m ...coord.replica` entry
+        # point): an in-process replica shares the coordinator's
+        # process-global rings and registry, so its spans/compiles are
+        # already visible locally and shipping them would only pickle
+        # bytes over loopback for the controller's pid-dedupe to drop
+        # (and double-report metrics, which carry no pid).
+        if ship_observability:
+            from ..utils.compile_ledger import LEDGER as _LEDGER
+            from ..utils.trace import TRACER as _TRACER
+
+            _TRACER.enable_ship()
+            _LEDGER.enable_ship()
+        self._ship_observability = bool(ship_observability)
+        self._metrics_last_ship = 0.0
+        self._metrics_last: list | None = None
         self._stop = threading.Event()
         # A rebalance initiated ELSEWHERE in this process (e.g. the
         # coordinator replanning after a planning-time exhaustion)
@@ -564,97 +584,16 @@ class ReplicaWorker:
     def _handle_command(self, conn, cmd: dict) -> None:
         kind = cmd["kind"]
         if kind == "CreateDataflow":
-            desc: DataflowDescription = cmd["desc"]
-            existing = self.dataflows.get(desc.name)
-            if (
-                existing is not None
-                and existing.fingerprint == desc.fingerprint()
+            # Adopt the DDL statement's propagated trace context
+            # (ISSUE 12): the install/hydration span joins the SAME
+            # tree as the coordinator's sequencing spans, piggybacked
+            # back on the next Frontiers report.
+            from ..utils.trace import TRACER
+
+            with TRACER.adopt(cmd.get("trace")), TRACER.span(
+                "replica.install", dataflow=cmd["desc"].name
             ):
-                existing.reported_upper = -1  # re-report frontier
-                # The counted reconciliation invariant (ISSUE 10): a
-                # kept dataflow increments `reconciles` and NOT
-                # `rebuilds` — a restarted controller whose replayed
-                # descriptions fingerprint-match must leave
-                # rebuilds == 0 (asserted in tests via mz_recovery).
-                self._count_recovery(desc.name, "reconciles")
-                self._send_installed(conn, desc.name, None)
-                return  # reconciliation: unchanged, keep running
-            try:
-                if existing is not None:
-                    # Replaced: rebuild it AND everything that imports
-                    # its arrangement (subscribers hold direct view
-                    # references).
-                    self._rebuild_cascade(desc.name, new_desc=desc)
-                else:
-                    self.dataflows[desc.name] = self._build(desc)
-                    self._count_recovery(desc.name, "installs")
-            except DictExhausted:
-                # Dense string insertions (e.g. a generative function's
-                # table over a polluted dictionary) ran a label gap dry.
-                # Rebalance + rebuild everything, then retry the
-                # install with remapped codes. Each rebalance evens ALL
-                # current strings, so repeated attempts make monotone
-                # progress; the bound guards a pathological treadmill.
-                import dataclasses as _dc
-
-                from ..expr.remap import remap_relation
-
-                desc2, err = desc, None
-                for _attempt in range(4):
-                    try:
-                        # A REPLACEMENT keeps the old dataflow in place
-                        # through the rebuild-all (its subscribers must
-                        # resolve their index imports); only a fresh
-                        # install attempt is dropped first.
-                        if existing is None:
-                            self.dataflows.pop(desc.name, None)
-                        remap = self._recover_dict_exhaustion(conn)
-                        # The incoming desc was planned pre-rebalance:
-                        # remap its codes too (the recovery pass only
-                        # covers already-installed descs).
-                        new_expr = remap_relation(desc2.expr, remap)
-                        if new_expr is not desc2.expr:
-                            desc2 = _dc.replace(desc2, expr=new_expr)
-                        if existing is not None:
-                            self._rebuild_cascade(
-                                desc2.name, new_desc=desc2
-                            )
-                        else:
-                            self.dataflows[desc2.name] = self._build(
-                                desc2
-                            )
-                            self._count_recovery(
-                                desc2.name, "installs"
-                            )
-                        err = None
-                        break
-                    except DictExhausted as e:
-                        err = (
-                            f"CreateDataflow {desc.name!r} failed "
-                            f"after dictionary rebalance: {e!r}"
-                        )
-                    except Exception as e:
-                        err = (
-                            f"CreateDataflow {desc.name!r} failed "
-                            f"after dictionary rebalance: {e!r}"
-                        )
-                        break
-                if err is None:
-                    self._send_installed(conn, desc.name, None)
-                else:
-                    if existing is None:
-                        self.dataflows.pop(desc.name, None)
-                    self._send_status(conn, err)
-                    self._send_installed(conn, desc.name, err)
-            except Exception as e:
-                # A bad plan must not kill the replica: report and skip
-                # (scoped halt!; the reference would crash-loop the whole
-                # process, we keep sibling dataflows alive).
-                err = f"CreateDataflow {desc.name!r} failed: {e!r}"
-                self._send_status(conn, err)
-                self._send_installed(conn, desc.name, err)
-            else:
-                self._send_installed(conn, desc.name, None)
+                self._handle_create_dataflow(conn, cmd)
         elif kind == "DropDataflow":
             inst = self.dataflows.pop(cmd["name"], None)
             self._recovery.pop(cmd["name"], None)
@@ -692,6 +631,111 @@ class ReplicaWorker:
 
             self.config.update(cmd["params"])
             COMPUTE_CONFIGS.update(cmd["params"])
+            if "trace_level" in cmd["params"]:
+                # The trace_level dyncfg drives THIS process's span
+                # recorder too (log_filter propagation, ISSUE 12).
+                from ..utils.trace import LEVELS, TRACER
+
+                lvl = cmd["params"]["trace_level"]
+                if lvl is None:  # reset-to-default delta
+                    from ..utils.dyncfg import TRACE_LEVEL
+
+                    lvl = TRACE_LEVEL.default
+                if lvl in LEVELS:
+                    TRACER.set_level(lvl)
+
+    def _handle_create_dataflow(self, conn, cmd: dict) -> None:
+        desc: DataflowDescription = cmd["desc"]
+        existing = self.dataflows.get(desc.name)
+        if (
+            existing is not None
+            and existing.fingerprint == desc.fingerprint()
+        ):
+            existing.reported_upper = -1  # re-report frontier
+            # The counted reconciliation invariant (ISSUE 10): a
+            # kept dataflow increments `reconciles` and NOT
+            # `rebuilds` — a restarted controller whose replayed
+            # descriptions fingerprint-match must leave
+            # rebuilds == 0 (asserted in tests via mz_recovery).
+            self._count_recovery(desc.name, "reconciles")
+            self._send_installed(conn, desc.name, None)
+            return  # reconciliation: unchanged, keep running
+        try:
+            if existing is not None:
+                # Replaced: rebuild it AND everything that imports
+                # its arrangement (subscribers hold direct view
+                # references).
+                self._rebuild_cascade(desc.name, new_desc=desc)
+            else:
+                self.dataflows[desc.name] = self._build(desc)
+                self._count_recovery(desc.name, "installs")
+        except DictExhausted:
+            # Dense string insertions (e.g. a generative function's
+            # table over a polluted dictionary) ran a label gap dry.
+            # Rebalance + rebuild everything, then retry the
+            # install with remapped codes. Each rebalance evens ALL
+            # current strings, so repeated attempts make monotone
+            # progress; the bound guards a pathological treadmill.
+            import dataclasses as _dc
+
+            from ..expr.remap import remap_relation
+
+            desc2, err = desc, None
+            for _attempt in range(4):
+                try:
+                    # A REPLACEMENT keeps the old dataflow in place
+                    # through the rebuild-all (its subscribers must
+                    # resolve their index imports); only a fresh
+                    # install attempt is dropped first.
+                    if existing is None:
+                        self.dataflows.pop(desc.name, None)
+                    remap = self._recover_dict_exhaustion(conn)
+                    # The incoming desc was planned pre-rebalance:
+                    # remap its codes too (the recovery pass only
+                    # covers already-installed descs).
+                    new_expr = remap_relation(desc2.expr, remap)
+                    if new_expr is not desc2.expr:
+                        desc2 = _dc.replace(desc2, expr=new_expr)
+                    if existing is not None:
+                        self._rebuild_cascade(
+                            desc2.name, new_desc=desc2
+                        )
+                    else:
+                        self.dataflows[desc2.name] = self._build(
+                            desc2
+                        )
+                        self._count_recovery(
+                            desc2.name, "installs"
+                        )
+                    err = None
+                    break
+                except DictExhausted as e:
+                    err = (
+                        f"CreateDataflow {desc.name!r} failed "
+                        f"after dictionary rebalance: {e!r}"
+                    )
+                except Exception as e:
+                    err = (
+                        f"CreateDataflow {desc.name!r} failed "
+                        f"after dictionary rebalance: {e!r}"
+                    )
+                    break
+            if err is None:
+                self._send_installed(conn, desc.name, None)
+            else:
+                if existing is None:
+                    self.dataflows.pop(desc.name, None)
+                self._send_status(conn, err)
+                self._send_installed(conn, desc.name, err)
+        except Exception as e:
+            # A bad plan must not kill the replica: report and skip
+            # (scoped halt!; the reference would crash-loop the whole
+            # process, we keep sibling dataflows alive).
+            err = f"CreateDataflow {desc.name!r} failed: {e!r}"
+            self._send_status(conn, err)
+            self._send_installed(conn, desc.name, err)
+        else:
+            self._send_installed(conn, desc.name, None)
 
     def _serve_peeks(self, conn) -> bool:
         served = False
@@ -817,6 +861,7 @@ class ReplicaWorker:
                 )
                 served = True
                 continue
+            t_wall, t0 = _time.time(), _time.perf_counter()
             rows = _result_rows(inst.view.result_batch(), inst.view.df)
             ctp.send_msg(
                 conn,
@@ -828,6 +873,12 @@ class ReplicaWorker:
                     "replica_id": self.replica_id,
                 },
             )
+            # The statement's replica-side span (ISSUE 12): recorded
+            # under the peek command's propagated context, shipped back
+            # on the next Frontiers piggyback — one tree per statement.
+            self._record_serve_span(
+                p, t_wall, t0, dataflow=p["dataflow"], rows=len(rows)
+            )
             served = True
         self.pending_peeks = keep
         for (
@@ -838,6 +889,21 @@ class ReplicaWorker:
                 conn, df_name, bound_cols, scan, ps
             )
         return served
+
+    def _record_serve_span(
+        self, cmd: dict, t_wall: float, t0: float, **attrs
+    ) -> None:
+        """Retroactive replica-side peek span under the command's
+        propagated trace context (no-op at level off / untraced)."""
+        from ..utils.trace import TRACER
+
+        if not TRACER.enabled("info"):
+            return
+        with TRACER.adopt(cmd.get("trace")):
+            TRACER.record(
+                "replica.peek", t_wall, _time.perf_counter() - t0,
+                **attrs,
+            )
 
     def _serve_lookup_bucket(
         self, conn, df_name: str, bound_cols: tuple, scan: bool, ps
@@ -880,6 +946,7 @@ class ReplicaWorker:
             probes = p["lookup"].get("probes") or []
             slices.append((len(all_probes), len(probes)))
             all_probes.extend(probes)
+        t_wall, t0 = _time.time(), _time.perf_counter()
         try:
             if inst is None:
                 raise RuntimeError(f"no such dataflow {df_name}")
@@ -895,6 +962,11 @@ class ReplicaWorker:
                 },
             )
             served_at = inst.view.upper - 1
+            self._record_serve_span(
+                next((p for p in ps if p.get("trace")), ps[0]),
+                t_wall, t0, dataflow=df_name, probes=len(all_probes),
+                batched=len(ps),
+            )
         except Exception as e:
             for p in ps:
                 ctp.send_msg(
@@ -927,6 +999,7 @@ class ReplicaWorker:
         epochs = {}
         donation = {}
         sharding = {}
+        abytes = {}
         for name, inst in self.dataflows.items():
             upper = inst.view.upper
             if upper != inst.reported_upper:
@@ -944,6 +1017,10 @@ class ReplicaWorker:
                 import numpy as _np
 
                 records[name] = inst.view.df.output_records()
+                # Device-resident bytes by spine component (ISSUE 12):
+                # pure metadata (shape * itemsize off the avals — no
+                # device read), same cadence as the row count.
+                abytes[name] = inst.view.device_bytes()
             # Buffer-provenance/donation verdicts (ISSUE 8) ride the
             # frontier report, but only when the verdict CHANGED (a
             # new subscriber, a dyncfg flip): steady state ships
@@ -971,17 +1048,51 @@ class ReplicaWorker:
                 rec = self._recovery.get(name)
                 if rec is not None and name in self.dataflows:
                     recovery[name] = dict(rec)
-        if changed or donation or sharding or recovery:
+        # Observability piggybacks (ISSUE 12): completed trace spans
+        # and compile records ship whenever present (empty in steady
+        # state / tracing off); the /metrics snapshot ships on the
+        # metrics_report_ms throttle and only when some value changed.
+        # Subprocess replicas only (see __init__).
+        spans, compiles, metrics = [], [], None
+        if self._ship_observability:
+            from ..utils.compile_ledger import LEDGER
+            from ..utils.trace import TRACER
+
+            spans = TRACER.drain_shippable()
+            compiles = LEDGER.drain_shippable()
+            metrics = self._metrics_snapshot()
+        if (changed or donation or sharding or recovery or spans
+                or compiles or metrics):
             ctp.send_msg(
                 conn,
                 ctp.frontiers(
                     changed, records, epochs, self.replica_id,
                     donation=donation, sharding=sharding,
-                    recovery=recovery,
+                    recovery=recovery, spans=spans, compiles=compiles,
+                    metrics=metrics, arrangement_bytes=abytes,
                 ),
             )
             return True
         return False
+
+    def _metrics_snapshot(self) -> list | None:
+        """This process's /metrics families for the controller-side
+        merged exposition, at most once per metrics_report_ms and only
+        on change (None = nothing to ship this report)."""
+        from ..utils.dyncfg import COMPUTE_CONFIGS, METRICS_REPORT_MS
+        from ..utils.metrics import REGISTRY
+
+        interval = float(METRICS_REPORT_MS(COMPUTE_CONFIGS)) / 1000.0
+        now = _time.monotonic()
+        if now - self._metrics_last_ship < max(interval, 0.05):
+            return None
+        fams = REGISTRY.families()
+        if fams == self._metrics_last:
+            self._metrics_last_ship = now
+            return None
+        self._metrics_last = fams
+        self._metrics_last_ship = now
+        return fams
 
 
 def serve_forever(
@@ -990,9 +1101,11 @@ def serve_forever(
     replica_id: str = "r0",
     ready_event: threading.Event | None = None,
     workers: int = 1,
+    ship_observability: bool = False,
 ) -> None:
     worker = ReplicaWorker(
-        location=location, replica_id=replica_id, workers=workers
+        location=location, replica_id=replica_id, workers=workers,
+        ship_observability=ship_observability,
     )
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -1027,12 +1140,22 @@ def main() -> None:
         help="devices in this replica's SPMD mesh",
     )
     args = ap.parse_args()
+    # This interpreter IS the replica: label its span recorder so
+    # piggybacked spans carry the replica identity (in-process test
+    # replicas share the coordinator's tracer and skip this).
+    from ..utils.trace import TRACER
+
+    TRACER.process = f"replica:{args.replica_id}"
     print(f"replica {args.replica_id} listening on {args.port}", flush=True)
     serve_forever(
         args.port,
         PersistLocation(args.blob, args.consensus),
         args.replica_id,
         workers=args.workers,
+        # This interpreter is a dedicated replica: its spans/compiles/
+        # metrics exist nowhere else, so piggyback them to the
+        # controller (in-process replicas skip this — shared rings).
+        ship_observability=True,
     )
 
 

@@ -1968,10 +1968,17 @@ class _DataflowBase:
             )
             return carry, deltas_all, sfls.any(axis=0), cfls.any(axis=0)
 
-        return jax.jit(
-            span,
-            compiler_options=_span_compiler_options(),
-            donate_argnums=(0, 1, 2, 3) if donate else (),
+        from ..utils.compile_ledger import ledger_jit
+
+        return ledger_jit(
+            jax.jit(
+                span,
+                compiler_options=_span_compiler_options(),
+                donate_argnums=(0, 1, 2, 3) if donate else (),
+            ),
+            "span_donated" if donate else "span",
+            self.name,
+            getattr(self, "_fingerprint", self.name),
         )
 
     def run_span(self, inputs_list: list, donate: bool = False):
@@ -2214,6 +2221,13 @@ class Dataflow(_DataflowBase):
         self.expr = expr
         self.name = name
         self.out_schema = expr.schema()
+        # Stable render identity for the compile ledger (ISSUE 12):
+        # pickled-MIR fingerprints are deterministic across installs
+        # and processes (PR 1), so a re-CREATE of the same definition
+        # ledgers its compiles as HITS — the program-bank opportunity.
+        from ..utils.compile_ledger import expr_fingerprint
+
+        self._fingerprint = expr_fingerprint(expr)
         self._str_keys, self._str_depth = strings.collect_keys(expr)
         ctx = _RenderContext({}, state_cap=state_cap)
         if out_slots is None:
@@ -2248,17 +2262,28 @@ class Dataflow(_DataflowBase):
         # side-tables as an extra jit input (expr/strings.py); others
         # keep the 4-argument signature (and their compile-cache
         # entries).
+        from ..utils.compile_ledger import ledger_jit
+
+        fp = getattr(self, "_fingerprint", self.name)
         self._span_jits = {}
         self._donated_step_jits = {}
         if self._str_keys:
-            self._step_jit = jax.jit(
-                lambda s, o, eo, i, t, env: self._step_core(
-                    s, o, eo, i, t, env
-                )
+            self._step_jit = ledger_jit(
+                jax.jit(
+                    lambda s, o, eo, i, t, env: self._step_core(
+                        s, o, eo, i, t, env
+                    )
+                ),
+                "step", self.name, fp,
             )
         else:
-            self._step_jit = jax.jit(
-                lambda s, o, eo, i, t: self._step_core(s, o, eo, i, t)
+            self._step_jit = ledger_jit(
+                jax.jit(
+                    lambda s, o, eo, i, t: self._step_core(
+                        s, o, eo, i, t
+                    )
+                ),
+                "step", self.name, fp,
             )
 
     def _donated_step_program(self, parts: tuple):
@@ -2273,6 +2298,8 @@ class Dataflow(_DataflowBase):
         parts = tuple(sorted(parts))
         jitfn = self._donated_step_jits.get(parts)
         if jitfn is None:
+            from ..utils.compile_ledger import ledger_jit
+
             argnums = tuple(
                 sorted(STEP_ARGNUM[p] for p in parts)
             )
@@ -2290,6 +2317,10 @@ class Dataflow(_DataflowBase):
                     ),
                     donate_argnums=argnums,
                 )
+            jitfn = ledger_jit(
+                jitfn, "step_donated", self.name,
+                getattr(self, "_fingerprint", self.name),
+            )
             self._donated_step_jits[parts] = jitfn
         return jitfn
 
@@ -2298,8 +2329,14 @@ class Dataflow(_DataflowBase):
         return b.with_capacity(cap) if cap > b.capacity else b
 
     def _make_compact_jit(self, max_level: int = 10**9):
-        return jax.jit(
-            lambda s, o: self._compact_core_single(s, o, max_level)
+        from ..utils.compile_ledger import ledger_jit
+
+        return ledger_jit(
+            jax.jit(
+                lambda s, o: self._compact_core_single(s, o, max_level)
+            ),
+            "compact", self.name,
+            getattr(self, "_fingerprint", self.name),
         )
 
     def _pack_inputs(self, inputs: dict) -> dict:
@@ -2430,6 +2467,9 @@ class ShardedDataflow(_DataflowBase):
         self.expr = expr
         self.mesh = mesh
         self.name = name
+        from ..utils.compile_ledger import expr_fingerprint
+
+        self._fingerprint = expr_fingerprint(expr)
         self._str_keys, self._str_depth = strings.collect_keys(expr)
         if len(mesh.axis_names) != 1:
             raise ValueError(
@@ -2713,8 +2753,13 @@ class ShardedDataflow(_DataflowBase):
         # The raw (un-jitted) step: the shard-spec abstract
         # interpreter traces it to reach the shard_map eqn's boundary
         # specs (analysis/shard_prop.trace_sharded_step).
+        from ..utils.compile_ledger import ledger_jit
+
         self._step_fn = step
-        self._step_jit = jax.jit(step)
+        self._step_jit = ledger_jit(
+            jax.jit(step), "step_spmd", self.name,
+            getattr(self, "_fingerprint", self.name),
+        )
 
     def run_span(self, inputs_list: list, donate: bool = False):
         raise NotImplementedError(
@@ -2772,7 +2817,12 @@ class ShardedDataflow(_DataflowBase):
                 check_vma=False,
             )(states, output)
 
-        return jax.jit(compact)
+        from ..utils.compile_ledger import ledger_jit
+
+        return ledger_jit(
+            jax.jit(compact), "compact_spmd", self.name,
+            getattr(self, "_fingerprint", self.name),
+        )
 
     def _pack_inputs(self, inputs: dict) -> dict:
         packed = {}

@@ -223,6 +223,27 @@ class SpanExecutor:
         if self.trace is not None:
             self.trace.append(rec)
         self.spans_committed += 1
+        from ..utils.trace import TRACER
+
+        if TRACER.enabled("debug"):
+            # Ring-buffer record of the committed span (ISSUE 12):
+            # DEBUG level so the default trace_level keeps the span
+            # boundary recorder-free; attrs mirror the bench --trace
+            # span schema so mz_trace_spans and the perfetto export
+            # see the same stage/dispatch/readback-wait decomposition.
+            TRACER.record(
+                "span_exec.commit",
+                _time.time(),  # host-sync: ok(pure host clock read)
+                (rec["readback_wait_ms"] or 0.0) / 1e3,
+                level="debug",
+                span=rec["span"],
+                ticks=rec["ticks"],
+                upload_ms=rec["upload_ms"],
+                dispatch_ms=rec["dispatch_ms"],
+                host_gap_ms=rec["host_gap_ms"],
+                donated=rec["donated"],
+                overflow=rec["overflow"],
+            )
         return deltas
 
     def sync(self):

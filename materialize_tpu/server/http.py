@@ -225,8 +225,19 @@ def make_handler(coordinator):
                 )
                 return
             if self.path == "/metrics":
+                # ONE scrape covers the deployment (ISSUE 12): the
+                # local registry merged with every replica's last
+                # piggybacked snapshot, remote samples labeled
+                # replica="<name>" (utils/metrics.cluster_exposition).
+                from ..utils.metrics import cluster_exposition
+
+                with coordinator.controller._lock:
+                    remote = dict(
+                        coordinator.controller.replica_metrics
+                    )
                 self._reply(
-                    200, REGISTRY.expose_text().encode(),
+                    200,
+                    cluster_exposition(REGISTRY, remote).encode(),
                     "text/plain; version=0.0.4",
                 )
             elif self.path in ("/api/readyz", "/api/livez"):
@@ -261,9 +272,12 @@ def make_handler(coordinator):
                         q for q in _split_statements(queries)
                         if q.strip()
                     ]
+                from ..utils.trace import TRACER
+
                 results = []
                 for q in queries or []:
-                    res = coordinator.execute(q)
+                    with TRACER.statement("http.query", sql=q[:100]):
+                        res = coordinator.execute(q)
                     if res.kind == "rows":
                         results.append(
                             {

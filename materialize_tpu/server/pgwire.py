@@ -258,11 +258,16 @@ class PgConnection:
 
     # -- queries ------------------------------------------------------------
     def _handle_query(self, sql: str) -> None:
-        with TRACER.span("pgwire.query", sql=sql[:100]):
-            for stmt in _split_statements(sql):
-                if not stmt.strip():
-                    self._send(_msg(b"I", b""))  # EmptyQueryResponse
-                    continue
+        # One trace per STATEMENT (ISSUE 12 drive-by: the statement
+        # root uses the shared trace-context API, so coordinator /
+        # controller / replica child spans all join this id space —
+        # mz_trace_spans shows one tree per statement, not one blob
+        # per simple-query batch).
+        for stmt in _split_statements(sql):
+            if not stmt.strip():
+                self._send(_msg(b"I", b""))  # EmptyQueryResponse
+                continue
+            with TRACER.statement("pgwire.query", sql=stmt[:100]):
                 try:
                     res = self.coord.execute(stmt)
                 except Exception as e:  # planning/execution error
@@ -421,7 +426,10 @@ class PgConnection:
                 if not po.sql.strip():
                     self._send(_msg(b"I", b""))  # EmptyQueryResponse
                     return
-                po.result = self.coord.execute(po.sql)
+                with TRACER.statement(
+                    "pgwire.execute", sql=po.sql[:100]
+                ):
+                    po.result = self.coord.execute(po.sql)
                 po.sent = 0
             res = po.result
             if res.kind == "rows" and getattr(res, "copy_out", False):

@@ -608,6 +608,28 @@ class MaintainedView:
             evicted_t, _ = self._history.pop(0)
             self._since = evicted_t
 
+    def device_bytes(self) -> dict:
+        """Device-resident bytes by component (ISSUE 12: the
+        mz_arrangement_sizes byte columns): the output spine's runs /
+        ingest slots / cached lanes plus the multiversion history's
+        retained device deltas. Pure aval metadata — no device read,
+        safe on the frontier-report path."""
+        from ...arrangement.spine import device_nbytes
+
+        out = getattr(self.df, "output", None)
+        if out is not None and hasattr(out, "device_bytes"):
+            bytes_ = dict(out.device_bytes())
+        else:
+            bytes_ = {
+                "runs": device_nbytes(out) if out is not None else 0,
+                "slots": 0,
+                "lanes": 0,
+            }
+        bytes_["history"] = device_nbytes(
+            [upd for _t, upd in self._history]
+        )
+        return bytes_
+
     def updates_as_of(self, t: int):
         """Host update arrays (cols, nulls, time, diff) of the
         maintained result rewound to time ``t``: the current result
@@ -1158,6 +1180,10 @@ class MaintainedView:
             self.df.time = ticks[0][0]
         # Our own dispatch must not self-sync through the registered
         # span barrier (that would serialize the double buffer).
+        from ...utils.trace import TRACER
+
+        t_wall = _time.time()  # host-sync: ok(pure host clock read)
+        t0 = _time.perf_counter()
         self._barrier.in_dispatch = True
         try:
             deltas = self.df.run_steps(
@@ -1167,6 +1193,12 @@ class MaintainedView:
             )
         finally:
             self._barrier.in_dispatch = False
+        if TRACER.enabled("debug"):
+            TRACER.record(
+                "view.span.dispatch", t_wall,
+                _time.perf_counter() - t0, level="debug",
+                ticks=len(ticks),
+            )
         snap = self.df.flags_snapshot()
         entries = [(t, out) for (t, _), out in zip(ticks, deltas)]
         self._window_ticks.extend(entries)
@@ -1182,7 +1214,11 @@ class MaintainedView:
         publish the span's deltas (device handoff), record history,
         and advance the committed frontier; an overflow triggers the
         whole-window rollback+replay."""
+        from ...utils.trace import TRACER
+
         snap, entries, target = handle
+        t_wall = _time.time()  # host-sync: ok(pure host clock read)
+        t0 = _time.perf_counter()
         if self.df.read_flags_snapshot(snap):
             self._recover_window()
             return
@@ -1191,6 +1227,15 @@ class MaintainedView:
             self._record_history(t, out)
             self._upper = t + 1
         self.span_epoch += 1
+        if TRACER.enabled("debug"):
+            # The span-commit cadence record (ISSUE 12): boundary
+            # readback wait + publish, at DEBUG so the default level
+            # keeps the per-span path recorder-free.
+            TRACER.record(
+                "view.span.commit", t_wall,
+                _time.perf_counter() - t0, level="debug",
+                ticks=len(entries), epoch=self.span_epoch,
+            )
 
     def _commit_inflight(self) -> bool:
         handle, self._inflight_span = self._inflight_span, None

@@ -1,0 +1,293 @@
+"""Compile ledger: every XLA compile, anywhere, becomes a record.
+
+ISSUE 12 tentpole (b), feeding ROADMAP item 4 (the ahead-of-time
+program bank): cold XLA compiles are the worst real-hardware numbers
+we have (~26s index step, 112s 4-operand sort, 227s q9 planning —
+PERF_NOTES), yet nothing attributed wall-clock to them. This module
+makes compilation a COUNTED surface: every jit program the system
+builds (dataflow step/span/compact programs, donated variants, peek
+gather programs) is wrapped with :func:`ledger_jit`, and each actual
+XLA compile records ``(program kind, dataflow name, dataflow
+fingerprint, tier vector, wall seconds, hit|miss)`` into a bounded
+per-process ring.
+
+Hit/miss semantics are the PROGRAM-BANK question, not jax's: a
+``miss`` means this (kind, fingerprint, tier) was never compiled in
+this process before; a ``hit`` means the same program was compiled
+AGAIN (a re-install, a restart re-render, a fresh jit wrapper after
+tier growth re-deriving an identical program). The total seconds spent
+on hits is exactly the wall-clock a cross-process program bank keyed
+by (fingerprint, tier) would recover.
+
+Detection rides ``jax.jit``'s own per-signature cache
+(``fn._cache_size()``): a call that grows the cache paid a trace +
+compile, and only then does the wrapper touch the ledger — the
+steady-state dispatch path pays two C attribute calls and a
+perf_counter read, no tree flattening, no device sync (the wrapper is
+registered with the host-sync linter).
+
+Replica processes piggyback their records on Frontiers responses (the
+span/verdict pattern); the controller ingests them, deduping by pid so
+in-process replicas (which share this ledger) never double-report.
+Surfaces: the ``mz_compile_log`` introspection relation, the
+``mz_compile_*`` /metrics families, EXPLAIN ANALYSIS's ``compiles:``
+block, and ``bench.py --trace``'s ``compiles`` summary.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time as _time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class CompileRecord:
+    kind: str  # step | step_donated | span | compact | peek_* | ...
+    name: str  # dataflow (or program owner) name
+    fingerprint: str  # stable identity of the rendered program family
+    tier: str  # tier vector: capacity/shape signature of this compile
+    seconds: float
+    cache: str  # "miss" (first sight) | "hit" (recompiled a known key)
+    when: float = 0.0  # wall-clock stamp
+    pid: int = 0
+    process: str = ""
+    attrs: dict = field(default_factory=dict)
+
+    def to_wire(self) -> tuple:
+        return (
+            self.kind, self.name, self.fingerprint, self.tier,
+            self.seconds, self.cache, self.when, self.pid,
+            self.process, dict(self.attrs),
+        )
+
+    @classmethod
+    def from_wire(cls, t: tuple) -> "CompileRecord":
+        return cls(*t[:9], attrs=t[9])
+
+
+class CompileLedger:
+    # Hit/miss memory: one entry per distinct (kind, fingerprint,
+    # tier) ever compiled, bounded so a long-lived deployment serving
+    # endless distinct ad-hoc programs cannot leak (oldest keys evict
+    # first; an evicted key's recompile re-classifies as "miss", which
+    # only UNDERSTATES the bankable wall).
+    SEEN_CAP = 32768
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._buf: deque[CompileRecord] = deque(maxlen=capacity)
+        self._ingested: deque[CompileRecord] = deque(maxlen=capacity)
+        self._seen: dict = {}  # insertion-ordered: FIFO eviction
+        self._ship: deque | None = None
+        self._pid = os.getpid()
+        self._metrics = None
+
+    def _metric_handles(self):
+        if self._metrics is None:
+            from .metrics import REGISTRY
+
+            self._metrics = (
+                REGISTRY.get_or_create(
+                    "counter", "mz_compile_total",
+                    "XLA program compiles observed by the ledger",
+                ),
+                REGISTRY.get_or_create(
+                    "counter", "mz_compile_misses_total",
+                    "compiles of a never-before-seen "
+                    "(kind, fingerprint, tier) key",
+                ),
+                REGISTRY.get_or_create(
+                    "counter", "mz_compile_hits_total",
+                    "recompiles of an already-seen key — the wall "
+                    "the program bank (ROADMAP 4) would recover",
+                ),
+                REGISTRY.get_or_create(
+                    "histogram", "mz_compile_seconds",
+                    "wall seconds per observed compile",
+                    buckets=(
+                        0.01, 0.05, 0.1, 0.5, 1, 5, 10, 30, 60, 120,
+                        300,
+                    ),
+                ),
+            )
+        return self._metrics
+
+    # -- recording ------------------------------------------------------------
+    def record(
+        self,
+        kind: str,
+        name: str,
+        fingerprint: str,
+        tier: str,
+        seconds: float,
+        **attrs,
+    ) -> CompileRecord:
+        key = (kind, fingerprint, tier)
+        with self._lock:
+            cache = "hit" if key in self._seen else "miss"
+            self._seen[key] = True
+            while len(self._seen) > self.SEEN_CAP:
+                self._seen.pop(next(iter(self._seen)))
+            rec = CompileRecord(
+                kind, name, fingerprint, tier, seconds, cache,
+                when=_time.time(), pid=os.getpid(),
+                process=f"pid{os.getpid()}", attrs=attrs,
+            )
+            self._buf.append(rec)
+            if self._ship is not None:
+                self._ship.append(rec)
+        total, misses, hits, hist = self._metric_handles()
+        total.inc()
+        (misses if cache == "miss" else hits).inc()
+        hist.observe(seconds)
+        return rec
+
+    # -- cross-process shipping (Frontiers piggyback) ------------------------
+    def enable_ship(self, capacity: int = 4096) -> None:
+        with self._lock:
+            if self._ship is None:
+                self._ship = deque(maxlen=capacity)
+
+    def drain_shippable(self) -> list[tuple]:
+        if self._ship is None or not self._ship:
+            return []
+        with self._lock:
+            out = [r.to_wire() for r in self._ship]
+            self._ship.clear()
+        return out
+
+    def ingest(self, wire_records: list, process: str = "") -> None:
+        me = os.getpid()
+        with self._lock:
+            for t in wire_records:
+                rec = CompileRecord.from_wire(t)
+                if rec.pid == me:
+                    continue  # in-process replica: already in _buf
+                if process:
+                    rec.process = process
+                self._ingested.append(rec)
+
+    # -- introspection --------------------------------------------------------
+    def records(self) -> list[CompileRecord]:
+        with self._lock:
+            return list(self._buf) + list(self._ingested)
+
+    def summary(self, names: set | None = None) -> dict:
+        """Totals (optionally scoped to dataflow ``names``): the
+        EXPLAIN ANALYSIS / bench.py surface."""
+        recs = self.records()
+        if names is not None:
+            recs = [r for r in recs if r.name in names]
+        out = {
+            "compiles": len(recs),
+            "misses": sum(1 for r in recs if r.cache == "miss"),
+            "hits": sum(1 for r in recs if r.cache == "hit"),
+            "seconds": round(sum(r.seconds for r in recs), 3),
+            "hit_seconds": round(
+                sum(r.seconds for r in recs if r.cache == "hit"), 3
+            ),
+            "by_kind": {},
+        }
+        for r in recs:
+            k = out["by_kind"].setdefault(
+                r.kind, {"compiles": 0, "seconds": 0.0}
+            )
+            k["compiles"] += 1
+            k["seconds"] = round(k["seconds"] + r.seconds, 3)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._ingested.clear()
+            self._seen.clear()
+            if self._ship is not None:
+                self._ship.clear()
+
+
+LEDGER = CompileLedger()
+
+
+def expr_fingerprint(obj) -> str:
+    """Stable short fingerprint of a rendered expression (the PR 1
+    fingerprint-stability work makes pickled MIR deterministic across
+    processes and installs — the program-bank key's first half)."""
+    import pickle
+
+    try:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception:
+        payload = repr(obj).encode()
+    return hashlib.blake2b(payload, digest_size=8).hexdigest()
+
+
+def tier_vector(args: tuple) -> str:
+    """Tier vector of one call signature: a digest of every array
+    leaf's (shape, dtype) plus the total operand bytes — the program
+    bank key's second half. Computed ONLY when a compile actually
+    happened (never on the steady-state dispatch path)."""
+    import jax
+
+    h = hashlib.blake2b(digest_size=6)
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(args):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            h.update(repr(leaf)[:32].encode())
+            continue
+        dt = getattr(leaf, "dtype", None)
+        h.update(str((tuple(shape), str(dt))).encode())
+        try:
+            total += leaf.size * leaf.dtype.itemsize
+        except (AttributeError, TypeError):
+            pass
+    return f"{h.hexdigest()}:{total}"
+
+
+class LedgeredJit:
+    """A ``jax.jit`` wrapper that records actual compiles. The hot
+    path costs two C attribute reads and a perf_counter call; ledger
+    work happens only on the (seconds-long) compile itself."""
+
+    __slots__ = ("fn", "kind", "name", "fingerprint", "ledger")
+
+    def __init__(self, fn, kind, name, fingerprint, ledger=None):
+        self.fn = fn
+        self.kind = kind
+        self.name = name
+        self.fingerprint = fingerprint
+        self.ledger = ledger if ledger is not None else LEDGER
+
+    def __call__(self, *args, **kwargs):
+        fn = self.fn
+        try:
+            n0 = fn._cache_size()
+        except (AttributeError, TypeError):  # jax without the API
+            return fn(*args, **kwargs)
+        t0 = _time.perf_counter()
+        out = fn(*args, **kwargs)
+        if fn._cache_size() > n0:
+            self.ledger.record(
+                self.kind,
+                self.name,
+                self.fingerprint,
+                tier_vector(args),
+                _time.perf_counter() - t0,
+            )
+        return out
+
+    def lower(self, *args, **kwargs):
+        return self.fn.lower(*args, **kwargs)
+
+    def _cache_size(self):
+        return self.fn._cache_size()
+
+
+def ledger_jit(fn, kind: str, name: str, fingerprint: str,
+               ledger=None) -> LedgeredJit:
+    """Wrap an already-jitted callable so its compiles hit the ledger."""
+    return LedgeredJit(fn, kind, name, fingerprint, ledger)

@@ -299,6 +299,34 @@ SUBSCRIBE_TAIL_POLL_MS = Config(
     "latency — data wakes the listen immediately)",
 ).register(COMPUTE_CONFIGS)
 
+# -- the observability plane (ISSUE 12) --------------------------------------
+
+TRACE_LEVEL = Config(
+    "trace_level", "info",
+    "statement-trace recording level (the log_filter system var "
+    "analog): 'off' disables span recording entirely, 'error' < "
+    "'info' < 'debug'. Statement/command spans record at info; the "
+    "per-span pipeline cadence (dispatch, readback-wait, commit, "
+    "fold) records at debug so the default level keeps the hot path "
+    "recorder-free. Propagates to replicas via UpdateConfiguration "
+    "like every dyncfg",
+).register(COMPUTE_CONFIGS)
+
+SLOW_STATEMENT_MS = Config(
+    "slow_statement_ms", 0.0,
+    "slow-statement log threshold in milliseconds: statements whose "
+    "end-to-end sequencing exceeds it are recorded (sql, wall ms, "
+    "trace_id) in the mz_slow_statements ring and counted in "
+    "/metrics. 0 disables (production default: opt in per deployment)",
+).register(COMPUTE_CONFIGS)
+
+METRICS_REPORT_MS = Config(
+    "metrics_report_ms", 2000.0,
+    "how often a replica piggybacks its /metrics sample snapshot on a "
+    "Frontiers response (deployment-wide scrape cadence): snapshots "
+    "ship at most once per interval and only when some value changed",
+).register(COMPUTE_CONFIGS)
+
 TRANSIENT_PEEK_CACHE = Config(
     "transient_peek_cache", 8,
     "memoize slow-path SELECT dataflows by description fingerprint: "

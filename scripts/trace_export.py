@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Export traces to Chrome trace-event JSON (load at perfetto.dev or
+chrome://tracing).
+
+Two input shapes (ISSUE 12 tentpole d):
+
+  python scripts/trace_export.py TRACE.json [-o out.chrome.json]
+      Convert a ``bench.py --trace`` emission: each mode's per-span
+      records become complete ("X") events on a host row and a device
+      row, so the pipelined overlap (dispatch of span K+1 riding over
+      span K's readback wait) is VISIBLE as overlapping slices.
+
+  python scripts/trace_export.py --spans SPANS.json [-o out...]
+      Convert a span-record dump (the ``mz_trace_spans`` shape: a
+      JSON array of {trace_id, span_id, parent_id, process, name,
+      start_us, duration_us, ...}) into one row per process.
+
+The conversion functions are importable (bench.py --trace uses
+``bench_trace_to_chrome`` to emit its perfetto file next to the JSON;
+tests schema-check ``validate_chrome_trace``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# Chrome trace-event format essentials: a JSON object with
+# "traceEvents": [{name, ph, ts (µs), dur (µs), pid, tid, args}, ...].
+REQUIRED_EVENT_KEYS = ("name", "ph", "ts", "pid", "tid")
+
+
+def _event(name, ts_us, dur_us, pid, tid, **args) -> dict:
+    return {
+        "name": name,
+        "ph": "X",
+        "ts": round(float(ts_us), 3),
+        "dur": round(max(float(dur_us), 0.0), 3),
+        "pid": pid,
+        "tid": tid,
+        "cat": "materialize_tpu",
+        "args": args,
+    }
+
+
+def _meta(pid, tid, what, label) -> dict:
+    return {
+        "name": what,
+        "ph": "M",
+        "ts": 0,
+        "pid": pid,
+        "tid": tid,
+        "args": {"name": label},
+    }
+
+
+def bench_trace_to_chrome(obj: dict) -> dict:
+    """``bench.py --trace`` JSON -> Chrome trace object. Host work
+    (gap + upload + dispatch) and device wait (readback) get separate
+    thread rows per mode; span timelines are reconstructed by
+    accumulating the per-span stage durations (the bench does not
+    record absolute stamps — relative layout preserves every duration
+    and the overlap structure that matters)."""
+    events: list = []
+    for pid, mode in enumerate(("pipelined", "serial")):
+        m = obj.get(mode)
+        if not m:
+            continue
+        events.append(_meta(pid, 0, "process_name", f"{mode} window"))
+        events.append(_meta(pid, 1, "thread_name", "host"))
+        events.append(_meta(pid, 2, "thread_name", "device-wait"))
+        cursor = 0.0
+        for rec in m.get("spans", ()):
+            t0 = cursor + rec.get("host_gap_ms", 0.0) * 1e3
+            up = rec.get("upload_ms", 0.0) * 1e3
+            disp = rec.get("dispatch_ms", 0.0) * 1e3
+            wait = (rec.get("readback_wait_ms") or 0.0) * 1e3
+            sync = rec.get("window_sync_ms", 0.0) * 1e3
+            label = f"span {rec.get('span')}"
+            if up:
+                events.append(
+                    _event(f"{label} upload", t0, up, pid, 1,
+                           ticks=rec.get("ticks"))
+                )
+            events.append(
+                _event(
+                    f"{label} dispatch", t0 + up, disp, pid, 1,
+                    ticks=rec.get("ticks"),
+                    donated=rec.get("donated"),
+                    overflow=rec.get("overflow"),
+                )
+            )
+            events.append(
+                _event(
+                    f"{label} readback-wait", t0 + up + disp, wait,
+                    pid, 2, readbacks=rec.get("readbacks"),
+                )
+            )
+            if sync:
+                events.append(
+                    _event(f"{label} window-sync", t0 + up + disp
+                           + wait, sync, pid, 2)
+                )
+            cursor = t0 + up + disp + wait + sync
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "materialize_tpu bench.py --trace",
+            "config": obj.get("config"),
+            "backend": obj.get("backend"),
+            "trace_id": obj.get("trace_id"),
+        },
+    }
+
+
+def spans_to_chrome(spans: list) -> dict:
+    """mz_trace_spans-shaped records -> Chrome trace object: one pid
+    per source process, spans as complete events at their wall-clock
+    stamps (already µs), trace/span ids in args so a perfetto query
+    can reassemble the statement tree."""
+    events: list = []
+    pids: dict = {}
+    for r in spans:
+        proc = str(r.get("process") or "unknown")
+        pid = pids.setdefault(proc, len(pids))
+        events.append(
+            _event(
+                str(r.get("name")),
+                float(r.get("start_us", 0)),
+                float(r.get("duration_us", 0)),
+                pid,
+                0,
+                trace_id=r.get("trace_id"),
+                span_id=r.get("span_id"),
+                parent_id=r.get("parent_id"),
+                level=r.get("level"),
+                **(r.get("attrs") or {}),
+            )
+        )
+    for proc, pid in pids.items():
+        events.append(_meta(pid, 0, "process_name", proc))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def tracer_records_to_chrome(records) -> dict:
+    """utils.trace.SpanRecord objects -> Chrome trace object."""
+    return spans_to_chrome(
+        [
+            {
+                "name": r.name,
+                "process": r.process,
+                "start_us": r.start * 1e6,
+                "duration_us": r.duration * 1e6,
+                "trace_id": r.trace_id,
+                "span_id": r.span_id,
+                "parent_id": r.parent_id,
+                "level": r.level,
+                "attrs": r.attrs,
+            }
+            for r in records
+        ]
+    )
+
+
+def validate_chrome_trace(obj: dict) -> list[str]:
+    """Schema check (tests + CI): returns violation strings, empty =
+    valid Chrome trace-event JSON."""
+    problems = []
+    events = obj.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i}: not an object")
+            continue
+        for k in REQUIRED_EVENT_KEYS:
+            if k not in ev:
+                problems.append(f"event {i}: missing {k!r}")
+        ph = ev.get("ph")
+        if ph not in ("X", "B", "E", "M", "i", "C"):
+            problems.append(f"event {i}: bad phase {ph!r}")
+        if ph == "X" and "dur" not in ev:
+            problems.append(f"event {i}: complete event missing dur")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            problems.append(f"event {i}: ts not numeric")
+    return problems
+
+
+def write_chrome_trace(path: str, obj: dict) -> str:
+    with open(path, "w") as f:
+        json.dump(obj, f)
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("input", help="bench --trace JSON (default) or "
+                    "a span-record JSON array (--spans)")
+    ap.add_argument("--spans", action="store_true",
+                    help="input is an mz_trace_spans-shaped array")
+    ap.add_argument("-o", "--output", default=None)
+    args = ap.parse_args(argv)
+    with open(args.input) as f:
+        data = json.load(f)
+    if args.spans or isinstance(data, list):
+        chrome = spans_to_chrome(data)
+    else:
+        chrome = bench_trace_to_chrome(data)
+    problems = validate_chrome_trace(chrome)
+    if problems:
+        for p in problems:
+            print(f"invalid: {p}", file=sys.stderr)
+        return 1
+    out = args.output or (
+        args.input.rsplit(".json", 1)[0] + ".chrome.json"
+    )
+    write_chrome_trace(out, chrome)
+    n = len(chrome["traceEvents"])
+    print(f"wrote {out} ({n} events); load it at https://ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,15 @@ TOP_KEYS = {
     "serial",
     "speedup_pipelined_vs_serial",
     "valid",
+    # ISSUE 12: the statement-trace id of the run, the compile
+    # ledger's wall-clock attribution, and the Chrome/Perfetto export
+    # path — the bench JSON is the contract perf dashboards read.
+    "trace_id",
+    "compiles",
+    "perfetto_path",
+}
+COMPILES_KEYS = {
+    "compiles", "misses", "hits", "seconds", "hit_seconds", "by_kind",
 }
 MODE_KEYS = {
     "ups",
@@ -56,10 +65,13 @@ GAP_KEYS = {"host_ms", "device_wait_ms", "wall_ms", "overlapped_ms"}
 
 
 @pytest.fixture(scope="module")
-def trace_output():
+def trace_output(tmp_path_factory):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_TRACE_SPANS"] = "3"
+    env["BENCH_TRACE_CHROME"] = str(
+        tmp_path_factory.mktemp("chrome") / "trace.chrome.json"
+    )
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--trace",
          "smoke"],
@@ -88,6 +100,38 @@ def test_trace_json_emitted_with_stable_schema(trace_output):
         assert m["spans"], f"{mode}: no span records"
         for rec in m["spans"]:
             assert SPAN_KEYS <= set(rec), (mode, set(rec))
+
+
+def test_trace_observability_fields(trace_output, tmp_path):
+    """ISSUE 12: --trace emits a statement trace id, the compile
+    ledger summary (the compile-wall attribution ROADMAP item 4's
+    program bank reads), and a VALID Chrome trace-event export."""
+    o = trace_output
+    assert isinstance(o["trace_id"], int) and o["trace_id"] > 0
+    c = o["compiles"]
+    assert COMPILES_KEYS <= set(c)
+    # A fresh subprocess compiled at least the span program family.
+    assert c["compiles"] >= 1
+    assert c["misses"] >= 1
+    assert c["seconds"] > 0
+    for kind, v in c["by_kind"].items():
+        assert {"compiles", "seconds"} <= set(v), kind
+    # The perfetto export exists and is schema-valid Chrome JSON.
+    assert o["perfetto_path"], "no perfetto export written"
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import trace_export
+
+    with open(o["perfetto_path"]) as f:
+        chrome = json.load(f)
+    assert trace_export.validate_chrome_trace(chrome) == []
+    assert chrome["traceEvents"], "empty chrome trace"
+    # Round-trip through the CLI converter too: bench JSON -> chrome.
+    src = tmp_path / "trace.json"
+    src.write_text(json.dumps(o))
+    out = tmp_path / "out.chrome.json"
+    assert trace_export.main([str(src), "-o", str(out)]) == 0
+    with open(out) as f:
+        assert trace_export.validate_chrome_trace(json.load(f)) == []
 
 
 def test_every_pipelined_span_has_one_readback(trace_output):

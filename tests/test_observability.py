@@ -1,0 +1,728 @@
+"""Observability plane (ISSUE 12): end-to-end statement traces across
+CTP, the compile ledger, deployment-wide metrics, slow-statement log,
+and exposition conformance.
+
+The acceptance facts live here: ONE SELECT driven through pgwire shows
+a single trace_id whose spans come from the pgwire front end, the
+coordinator, the controller, AND the replica SUBPROCESS (context
+propagated over CTP commands, completed spans piggybacked back on
+Frontiers); a fresh DDL logs compile-ledger misses and a repeated
+install of the identical definition logs hits."""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time as _time
+
+import pytest
+
+from materialize_tpu.utils.compile_ledger import (
+    CompileLedger,
+    LEDGER,
+    expr_fingerprint,
+)
+from materialize_tpu.utils.metrics import (
+    MetricsRegistry,
+    cluster_exposition,
+)
+from materialize_tpu.utils.trace import TRACER, Tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_coord(tmp_path, with_replica=True, subprocess_replica=False):
+    from materialize_tpu.coord.coordinator import Coordinator
+    from materialize_tpu.coord.protocol import PersistLocation
+    from materialize_tpu.coord.replica import serve_forever
+    from materialize_tpu.storage.persist import (
+        FileBlob,
+        PersistClient,
+        SqliteConsensus,
+    )
+    from materialize_tpu.testing.chaos import ReplicaProcess, _free_port
+
+    loc = PersistLocation(
+        str(tmp_path / "blob"), str(tmp_path / "consensus.db")
+    )
+    cleanup = []
+    if with_replica:
+        port = _free_port()
+        if subprocess_replica:
+            rp = ReplicaProcess(
+                loc.blob_root, loc.consensus_path, port, rid="r0"
+            )
+            cleanup.append(rp.stop)
+        else:
+            ready = threading.Event()
+            threading.Thread(
+                target=serve_forever, args=(port, loc, "r0", ready),
+                daemon=True,
+            ).start()
+            assert ready.wait(10)
+    c = Coordinator(
+        PersistClient(
+            FileBlob(loc.blob_root), SqliteConsensus(loc.consensus_path)
+        ),
+        tick_interval=None,
+    )
+    if with_replica:
+        c.add_replica("r0", ("127.0.0.1", port))
+    return c, cleanup
+
+
+# ---------------------------------------------------------------------------
+# the tentpole acceptance: one statement, one tree, four layers
+# ---------------------------------------------------------------------------
+
+
+class TestTraceEndToEnd:
+    def test_one_select_one_trace_across_processes(self, tmp_path):
+        """A SELECT through pgwire produces ONE trace_id whose spans
+        cover pgwire -> coordinator -> controller -> the replica
+        subprocess, the replica half arriving over the Frontiers
+        piggyback with the replica's process label."""
+        from materialize_tpu.server.pgwire import PgServer
+        from materialize_tpu.testing.chaos import subprocess_available
+        from tests.test_server import MiniPg
+
+        if not subprocess_available():
+            pytest.skip("cannot spawn replica subprocesses here")
+        coord, cleanup = _make_coord(
+            tmp_path, subprocess_replica=True
+        )
+        pg = PgServer(coord).start()
+        try:
+            client = MiniPg(pg.port)
+            _, _, err, _ = client.query(
+                "CREATE TABLE ot (k BIGINT NOT NULL, v BIGINT)"
+            )
+            assert err is None, err
+            client.query("INSERT INTO ot VALUES (1, 10), (2, 20)")
+            _, _, err, _ = client.query(
+                "CREATE MATERIALIZED VIEW omv AS SELECT k, v FROM ot"
+            )
+            assert err is None, err
+            cols, rows, err, _ = client.query("SELECT * FROM omv")
+            assert err is None, err
+            assert sorted(tuple(r) for r in rows) == [
+                ("1", "10"), ("2", "20")
+            ]
+
+            # The replica's spans arrive asynchronously on the next
+            # Frontiers piggyback: poll mz_trace_spans until the
+            # statement's tree is complete (or fail with what we saw).
+            deadline = _time.monotonic() + 30.0
+            tree = {}
+            while _time.monotonic() < deadline:
+                res = coord.execute(
+                    "SELECT trace_id, span_id, parent_id, process, "
+                    "name FROM mz_trace_spans"
+                )
+                spans = res.rows
+                roots = [
+                    r for r in spans
+                    if r[4] == "pgwire.query"
+                    and "SELECT * FROM omv" in self._root_sql(
+                        coord, r[0]
+                    )
+                ]
+                if roots:
+                    tid = roots[-1][0]
+                    tree = {
+                        r[1]: r for r in spans if r[0] == tid
+                    }
+                    names = {r[4] for r in tree.values()}
+                    if {"pgwire.query", "coord.execute",
+                            "replica.peek"} <= names and any(
+                        n.startswith("controller.") for n in names
+                    ):
+                        break
+                _time.sleep(0.1)
+            names = {r[4] for r in tree.values()}
+            assert "pgwire.query" in names, names
+            assert "coord.execute" in names, names
+            assert any(
+                n.startswith("controller.peek") for n in names
+            ), names
+            assert "replica.peek" in names, names
+            # The replica span CROSSED processes: its process label is
+            # the subprocess replica's, and its parent is a
+            # coordinator-process controller span in the SAME tree.
+            rep_spans = [
+                r for r in tree.values() if r[4] == "replica.peek"
+            ]
+            assert rep_spans and all(
+                r[3] == "replica:r0" for r in rep_spans
+            ), rep_spans
+            for r in rep_spans:
+                parent = tree.get(r[2])
+                assert parent is not None, (
+                    "replica span's parent not in the tree", r, tree
+                )
+                assert parent[4].startswith("controller.peek")
+            # Every non-root span links to a parent inside the tree.
+            for r in tree.values():
+                if r[4] == "pgwire.query":
+                    assert r[2] == 0  # root
+                else:
+                    assert r[2] in tree, (r, sorted(names))
+            # Same piggyback channel, metrics half (tentpole c): the
+            # subprocess replica's /metrics samples arrive labeled
+            # replica=r0 in mz_metrics AND in the merged exposition.
+            deadline = _time.monotonic() + 30.0
+            hit = []
+            while _time.monotonic() < deadline and not hit:
+                from materialize_tpu.coord.introspection import (
+                    snapshot,
+                )
+                from materialize_tpu.repr.schema import GLOBAL_DICT
+
+                hit = [
+                    code for code, _v in snapshot(coord, "mz_metrics")
+                    if "replica=r0" in GLOBAL_DICT.decode(code)
+                ]
+                if not hit:
+                    client.query("INSERT INTO ot VALUES (3, 30)")
+                    _time.sleep(0.3)
+            assert hit, "no replica-labeled metrics arrived"
+            from materialize_tpu.utils.metrics import (
+                REGISTRY,
+                cluster_exposition,
+            )
+
+            with coord.controller._lock:
+                remote = dict(coord.controller.replica_metrics)
+            text = cluster_exposition(REGISTRY, remote)
+            assert 'replica="r0"' in text
+            parse_exposition(text)  # conformant merged exposition
+        finally:
+            pg.stop()
+            coord.shutdown()
+            for fn in cleanup:
+                fn()
+
+    @staticmethod
+    def _root_sql(coord, trace_id: int) -> str:
+        for r in TRACER.records():
+            if r.trace_id == trace_id and r.name == "pgwire.query":
+                return str(r.attrs.get("sql", ""))
+        return ""
+
+    def test_trace_level_off_records_nothing(self, tmp_path):
+        coord, cleanup = _make_coord(tmp_path)
+        marker = "SELECT 8675309"
+        try:
+            coord.execute("SET trace_level = 'off'")
+            coord.execute(marker)
+            # Background threads of sibling tests may record spans
+            # concurrently; the assertion is scoped to THIS statement.
+            assert not any(
+                str(r.attrs.get("sql", "")).startswith(marker)
+                for r in TRACER.records()
+            )
+            coord.execute("SET trace_level = 'info'")
+            coord.execute(marker)
+            assert any(
+                str(r.attrs.get("sql", "")).startswith(marker)
+                for r in TRACER.records()
+            )
+        finally:
+            coord.execute("SET trace_level = 'info'")
+            coord.shutdown()
+            for fn in cleanup:
+                fn()
+
+    def test_bad_trace_level_rejected(self, tmp_path):
+        from materialize_tpu.sql.hir import PlanError
+
+        coord, cleanup = _make_coord(tmp_path, with_replica=False)
+        try:
+            with pytest.raises(PlanError):
+                coord.execute("SET trace_level = 'verbose'")
+        finally:
+            coord.shutdown()
+            for fn in cleanup:
+                fn()
+
+
+# ---------------------------------------------------------------------------
+# compile ledger
+# ---------------------------------------------------------------------------
+
+
+class TestCompileLedger:
+    def test_hit_miss_classification(self):
+        led = CompileLedger()
+        r1 = led.record("step", "df1", "fp1", "tierA", 1.5)
+        r2 = led.record("step", "df1", "fp1", "tierA", 0.3)
+        r3 = led.record("step", "df1", "fp1", "tierB", 0.2)
+        r4 = led.record("span", "df1", "fp1", "tierA", 0.1)
+        assert r1.cache == "miss"
+        assert r2.cache == "hit"  # same (kind, fp, tier) seen
+        assert r3.cache == "miss"  # new tier
+        assert r4.cache == "miss"  # new kind
+        s = led.summary()
+        assert s["compiles"] == 4
+        assert s["hits"] == 1 and s["misses"] == 3
+        assert s["hit_seconds"] == 0.3
+        assert s["by_kind"]["step"]["compiles"] == 3
+
+    def test_ledger_jit_detects_compiles(self):
+        import jax
+        import jax.numpy as jnp
+
+        from materialize_tpu.utils.compile_ledger import ledger_jit
+
+        led = CompileLedger()
+        fn = ledger_jit(
+            jax.jit(lambda x: x + 1), "step", "t", "fp", ledger=led
+        )
+        fn(jnp.ones(3))
+        assert len(led.records()) == 1
+        fn(jnp.ones(3))  # cached: no new record
+        assert len(led.records()) == 1
+        fn(jnp.ones(5))  # new signature: compile, new tier -> miss
+        recs = led.records()
+        assert len(recs) == 2
+        assert all(r.cache == "miss" for r in recs)
+        assert recs[0].tier != recs[1].tier
+        # A FRESH jit of the same program family at a seen tier is the
+        # program-bank hit.
+        fn2 = ledger_jit(
+            jax.jit(lambda x: x + 1), "step", "t", "fp", ledger=led
+        )
+        fn2(jnp.ones(3))
+        assert led.records()[-1].cache == "hit"
+
+    def test_fresh_ddl_misses_and_reinstall_hits(self, tmp_path):
+        """Acceptance: a fresh DDL logs >=1 miss to mz_compile_log; a
+        DROP + identical re-CREATE logs a hit (the wall a program bank
+        keyed by (fingerprint, tier) would recover)."""
+        coord, cleanup = _make_coord(tmp_path)
+        try:
+            coord.execute("CREATE TABLE clt (a INT, b INT)")
+            coord.execute("INSERT INTO clt VALUES (1, 2)")
+            coord.execute(
+                "CREATE MATERIALIZED VIEW clmv AS "
+                "SELECT a, b FROM clt"
+            )
+            coord.execute("SELECT * FROM clmv")
+            res = coord.execute(
+                "SELECT kind, cache FROM mz_compile_log "
+                "WHERE dataflow = 'clmv'"
+            )
+            assert any(c == "miss" for _k, c in res.rows), res.rows
+            # Identical re-install: same expr -> same fingerprint ->
+            # the recompile ledgers as a HIT.
+            coord.execute("DROP VIEW clmv")
+            coord.execute(
+                "CREATE MATERIALIZED VIEW clmv AS "
+                "SELECT a, b FROM clt"
+            )
+            coord.execute("SELECT * FROM clmv")
+            res = coord.execute(
+                "SELECT kind, cache FROM mz_compile_log "
+                "WHERE dataflow = 'clmv' AND cache = 'hit'"
+            )
+            assert res.rows, "re-install of an identical MV logged no hit"
+            # EXPLAIN ANALYSIS prints the compiles: block with totals.
+            txt = coord.execute(
+                "EXPLAIN ANALYSIS SELECT * FROM clmv"
+            ).text
+            assert "compiles:" in txt
+            assert "total: compiles=" in txt
+            assert "seconds=" in txt
+            assert "bankable_seconds=" in txt
+        finally:
+            coord.shutdown()
+            for fn in cleanup:
+                fn()
+
+    def test_fingerprint_stable_across_objects(self):
+        from materialize_tpu.expr import relation as mir
+        from materialize_tpu.repr.schema import (
+            Column,
+            ColumnType,
+            Schema,
+        )
+
+        sch = Schema((Column("k", ColumnType.INT64),))
+        a = mir.Get("x", sch)
+        b = mir.Get("x", sch)
+        assert expr_fingerprint(a) == expr_fingerprint(b)
+        assert expr_fingerprint(a) != expr_fingerprint(
+            mir.Get("y", sch)
+        )
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition conformance + quantile edges (satellite)
+# ---------------------------------------------------------------------------
+
+
+def parse_exposition(text: str) -> dict:
+    """Strict mini-parser of the Prometheus text format: returns
+    {family: {"type": kind, "samples": [(name, labels, value)]}};
+    raises on malformed lines, duplicate TYPE headers, or samples
+    outside their family."""
+    import re
+
+    families: dict = {}
+    current = None
+    line_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(?:\{([^}]*)\})?"
+        r" (-?[0-9.eE+\-]+|[+-]Inf|NaN)$"
+    )
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        if ln.startswith("# HELP "):
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, kind = ln.split(" ", 3)
+            if name in families:
+                raise ValueError(f"duplicate TYPE for {name}")
+            if kind not in ("counter", "gauge", "histogram",
+                            "summary", "untyped"):
+                raise ValueError(f"bad kind {kind!r}")
+            families[name] = {"type": kind, "samples": []}
+            current = name
+            continue
+        if ln.startswith("#"):
+            raise ValueError(f"unknown comment line {ln!r}")
+        m = line_re.match(ln)
+        if m is None:
+            raise ValueError(f"malformed sample line {ln!r}")
+        name, raw_labels, value = m.groups()
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in (
+                families
+            ):
+                fam = name[: -len(suffix)]
+        if fam != current:
+            # samples must follow their family header contiguously
+            if fam not in families:
+                raise ValueError(f"sample {name!r} without TYPE")
+        labels = {}
+        if raw_labels:
+            for part in raw_labels.split(","):
+                k, v = part.split("=", 1)
+                if not (v.startswith('"') and v.endswith('"')):
+                    raise ValueError(f"unquoted label value in {ln!r}")
+                labels[k] = v[1:-1]
+        families[fam]["samples"].append((name, labels, float(value)))
+    return families
+
+
+class TestPrometheusConformance:
+    def test_histogram_exposition_parses_and_is_cumulative(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("obs_h_seconds", "latency",
+                          buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        c = reg.counter("obs_c_total", "count with \n newline help")
+        c.inc(3)
+        fams = parse_exposition(reg.expose_text())
+        assert fams["obs_h_seconds"]["type"] == "histogram"
+        buckets = [
+            (labels["le"], v)
+            for name, labels, v in fams["obs_h_seconds"]["samples"]
+            if name == "obs_h_seconds_bucket"
+        ]
+        # le labels include +Inf; counts are CUMULATIVE.
+        assert [b[0] for b in buckets] == ["0.1", "1.0", "10.0", "+Inf"]
+        assert [b[1] for b in buckets] == [1.0, 3.0, 4.0, 5.0]
+        sums = {
+            name: v
+            for name, labels, v in fams["obs_h_seconds"]["samples"]
+            if not name.endswith("_bucket")
+        }
+        assert sums["obs_h_seconds_count"] == 5.0
+        assert abs(sums["obs_h_seconds_sum"] - 56.05) < 1e-9
+        assert fams["obs_c_total"]["samples"][0][2] == 3.0
+
+    def test_bucket_counts_render_as_integers(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("obs_int_h", buckets=(1.0,))
+        h.observe(0.5)
+        text = reg.expose_text()
+        assert 'obs_int_h_bucket{le="1.0"} 1\n' in text
+        assert 'obs_int_h_count 1' in text
+
+    def test_quantile_edge_cases(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("obs_q", buckets=(0.1, 1.0, 10.0))
+        assert h.quantile(0.5) == 0.0  # empty
+        h.observe(0.5)  # single observation in bucket le=1.0
+        assert h.quantile(0.0) == 1.0  # first NONEMPTY bucket
+        assert h.quantile(0.5) == 1.0
+        assert h.quantile(1.0) == 1.0
+        h2 = reg.histogram("obs_q2", buckets=(0.1, 1.0))
+        h2.observe(5.0)  # only the overflow bucket
+        assert h2.quantile(0.5) == float("inf")
+        assert h2.quantile(0.0) == float("inf")
+        h3 = reg.histogram("obs_q3", buckets=(0.1, 1.0))
+        h3.observe(0.05)
+        h3.observe(5.0)
+        assert h3.quantile(0.0) == 0.1
+        assert h3.quantile(0.25) == 0.1
+        assert h3.quantile(1.0) == float("inf")
+        # q outside [0, 1] clamps instead of nonsense.
+        assert h3.quantile(-1) == 0.1
+        assert h3.quantile(2) == float("inf")
+
+    def test_cluster_exposition_merges_with_replica_label(self):
+        local = MetricsRegistry()
+        local.counter("shared_total", "help").inc(1)
+        remote_reg = MetricsRegistry()
+        remote_reg.counter("shared_total", "help").inc(5)
+        remote_reg.gauge("replica_only").set(7)
+        text = cluster_exposition(
+            local, {"r0": remote_reg.families()}
+        )
+        fams = parse_exposition(text)  # raises on duplicate TYPE
+        samples = fams["shared_total"]["samples"]
+        assert (
+            "shared_total", {}, 1.0
+        ) in samples
+        assert ("shared_total", {"replica": "r0"}, 5.0) in samples
+        assert fams["replica_only"]["samples"] == [
+            ("replica_only", {"replica": "r0"}, 7.0)
+        ]
+
+
+# ---------------------------------------------------------------------------
+# concurrency: consistent snapshots under writer storms (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestIntrospectionConcurrency:
+    def test_mz_metrics_and_trace_spans_under_writers(self, tmp_path):
+        """Reader snapshots of mz_metrics / mz_trace_spans stay
+        well-formed while writer threads hammer the tracer and the
+        registry — no torn reads, no dict-mutation races."""
+        from materialize_tpu.utils.metrics import REGISTRY
+
+        coord, cleanup = _make_coord(tmp_path, with_replica=False)
+        stop = threading.Event()
+        errors: list = []
+        N_WRITERS = 4
+
+        def span_writer(i):
+            try:
+                while not stop.is_set():
+                    with TRACER.span(f"conc.w{i}", worker=i):
+                        with TRACER.span("conc.inner"):
+                            pass
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def metric_writer(i):
+            try:
+                name = f"conc_total_{i}_{os.getpid()}"
+                m = REGISTRY.get(name) or REGISTRY.counter(name)
+                h_name = f"conc_h_{i}_{os.getpid()}"
+                h = REGISTRY.get(h_name) or REGISTRY.histogram(h_name)
+                while not stop.is_set():
+                    m.inc()
+                    h.observe(0.01 * i)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=span_writer, args=(i,),
+                             daemon=True)
+            for i in range(N_WRITERS)
+        ] + [
+            threading.Thread(target=metric_writer, args=(i,),
+                             daemon=True)
+            for i in range(N_WRITERS)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            from materialize_tpu.coord.introspection import snapshot
+            from materialize_tpu.repr.schema import GLOBAL_DICT
+
+            # Hammer the raw row constructors (where a torn read or
+            # dict-mutation race would live) for the whole window...
+            deadline = _time.monotonic() + 3.0
+            reads = 0
+            while _time.monotonic() < deadline:
+                for vals in snapshot(coord, "mz_metrics"):
+                    assert isinstance(vals[-1], float)
+                for vals in snapshot(coord, "mz_trace_spans"):
+                    assert vals[-1] >= 0  # duration_us
+                reads += 1
+            assert reads >= 10, reads
+            # ...then one full SQL read through the renderer too.
+            res = coord.execute(
+                "SELECT metric, value FROM mz_metrics"
+            )
+            assert res.rows
+            res = coord.execute(
+                "SELECT name, duration_us FROM mz_trace_spans"
+            )
+            assert res.rows
+            assert not errors, errors
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+            coord.shutdown()
+            for fn in cleanup:
+                fn()
+
+
+# ---------------------------------------------------------------------------
+# slow-statement log + arrangement bytes + cluster relations
+# ---------------------------------------------------------------------------
+
+
+class TestSlowStatements:
+    def test_threshold_gates_the_log(self, tmp_path):
+        coord, cleanup = _make_coord(tmp_path, with_replica=False)
+        try:
+            coord.execute("CREATE TABLE slt_t (a INT)")
+            # Disabled by default: nothing logged.
+            assert coord.execute(
+                "SELECT * FROM mz_slow_statements"
+            ).rows == []
+            coord.update_config({"slow_statement_ms": 0.0001})
+            coord.execute("INSERT INTO slt_t VALUES (1)")
+            res = coord.execute(
+                "SELECT sql, ms FROM mz_slow_statements"
+            )
+            assert any(
+                "INSERT INTO slt_t" in sql for sql, _ms in res.rows
+            ), res.rows
+            assert all(ms > 0 for _sql, ms in res.rows)
+        finally:
+            coord.update_config({"slow_statement_ms": None})
+            coord.shutdown()
+            for fn in cleanup:
+                fn()
+
+
+class TestArrangementBytes:
+    def test_device_bytes_per_component(self, tmp_path):
+        coord, cleanup = _make_coord(tmp_path)
+        try:
+            coord.execute("CREATE TABLE abt (a INT, b INT)")
+            coord.execute("INSERT INTO abt VALUES (1, 2), (3, 4)")
+            coord.execute(
+                "CREATE MATERIALIZED VIEW abmv AS "
+                "SELECT a, b FROM abt"
+            )
+            coord.execute("SELECT * FROM abmv")
+            deadline = _time.monotonic() + 20.0
+            rows = []
+            while _time.monotonic() < deadline:
+                rows = coord.execute(
+                    "SELECT records, bytes, runs_bytes, slots_bytes, "
+                    "lanes_bytes, history_bytes "
+                    "FROM mz_arrangement_sizes "
+                    "WHERE dataflow = 'abmv'"
+                ).rows
+                if rows and rows[0][1] > 0:
+                    break
+                _time.sleep(0.1)
+            assert rows, "no mz_arrangement_sizes row for abmv"
+            records, total, runs, slots, lanes, hist = rows[0]
+            assert records == 2
+            assert runs > 0
+            assert total == runs + slots + lanes + hist
+        finally:
+            coord.shutdown()
+            for fn in cleanup:
+                fn()
+
+
+# ---------------------------------------------------------------------------
+# tracer unit behavior new in ISSUE 12
+# ---------------------------------------------------------------------------
+
+
+class TestTracerContexts:
+    def test_statement_mints_distinct_trace_ids(self):
+        tr = Tracer()
+        with tr.statement("s1") as a:
+            t1 = tr.current_trace()
+            assert tr.context() == {"t": t1, "s": a}
+        with tr.statement("s2"):
+            t2 = tr.current_trace()
+        assert t1 != t2
+        recs = {r.name: r for r in tr.records()}
+        assert recs["s1"].trace_id == t1
+        assert recs["s2"].trace_id == t2
+        assert recs["s1"].parent_id is None
+
+    def test_adopt_links_remote_child(self):
+        tr = Tracer()
+        with tr.statement("root"):
+            ctx = tr.context()
+        remote = Tracer()
+        with remote.adopt(ctx):
+            with remote.span("child"):
+                pass
+        child = remote.records()[0]
+        assert child.trace_id == ctx["t"]
+        assert child.parent_id == ctx["s"]
+
+    def test_ship_and_ingest_dedupe_by_pid(self):
+        tr = Tracer()
+        tr.enable_ship()
+        with tr.span("shipped"):
+            pass
+        wire = tr.drain_shippable()
+        assert len(wire) == 1
+        assert tr.drain_shippable() == []
+        # Same-pid ingest is dropped (in-process replica sharing).
+        tr.ingest(wire, process="r0")
+        assert len(tr.records()) == 1
+        # A foreign pid lands, relabeled with the replica name.
+        foreign = list(wire[0])
+        foreign[-1] = wire[0][-1] + 1  # pid field
+        tr2 = Tracer()
+        tr2.ingest([tuple(foreign)], process="r9")
+        recs = tr2.records()
+        assert len(recs) == 1 and recs[0].process == "r9"
+
+    def test_record_is_levelled(self):
+        tr = Tracer()
+        assert tr.record("dbg", 0.0, 0.1, level="debug") is None
+        tr.set_level("debug")
+        assert tr.record("dbg", 0.0, 0.1, level="debug") is not None
+
+    def test_span_ids_embed_pid(self):
+        tr = Tracer()
+        with tr.span("x") as sid:
+            pass
+        assert sid >> 40 == os.getpid() & 0x3FFFFF
+
+
+# ---------------------------------------------------------------------------
+# chrome export of tracer records
+# ---------------------------------------------------------------------------
+
+
+class TestTraceExport:
+    def test_spans_to_chrome_valid(self):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import trace_export
+
+        tr = Tracer(process="unit")
+        with tr.statement("stmt"):
+            with tr.span("inner"):
+                pass
+        chrome = trace_export.tracer_records_to_chrome(tr.records())
+        assert trace_export.validate_chrome_trace(chrome) == []
+        names = {e["name"] for e in chrome["traceEvents"]}
+        assert {"stmt", "inner"} <= names
+        # json-serializable end to end
+        json.dumps(chrome)
