@@ -90,6 +90,19 @@ RECORDER_PATH = (
         "MaintainedView._commit_span",
     ),
     ("materialize_tpu.render.span_exec", "SpanExecutor._complete"),
+    # The freshness plane (ISSUE 15): wallclock-lag recording at every
+    # committed span boundary must be pure host bookkeeping — deque
+    # appends, a histogram bucket walk, and the SLO comparison.
+    ("materialize_tpu.coord.freshness", "lag_ms"),
+    ("materialize_tpu.coord.freshness", "FreshnessRecorder.record"),
+    (
+        "materialize_tpu.coord.freshness",
+        "FreshnessRecorder._check_slo",
+    ),
+    (
+        "materialize_tpu.storage.persist.operators",
+        "MaintainedView._record_freshness",
+    ),
 )
 
 DEFAULT_HOT_PATH = DEFAULT_HOT_PATH + RECORDER_PATH

@@ -560,6 +560,18 @@ class ComputeController:
         # TRACER / LEDGER (pid-deduped), not controller state.
         self.arrangement_bytes: dict[str, dict[str, dict]] = {}
         self.replica_metrics: dict[str, list] = {}
+        # Freshness plane (ISSUE 15): the per-(dataflow, replica)
+        # hydration status board (pending -> hydrating -> hydrated ->
+        # stalled, with bounded transition history). Seeded "pending"
+        # at create_dataflow/add_replica, overwritten by replica
+        # piggybacks, and stamped "stalled" by wait_installed when the
+        # install budget expires without an ack. Own lock (StatusBoard)
+        # so the absorber, DDL waits, and introspection never contend
+        # on controller._lock. Lag records go to the process-global
+        # FRESHNESS recorder (pid-deduped), not controller state.
+        from .freshness import StatusBoard
+
+        self.hydration = StatusBoard()
         self.statuses: deque = deque(maxlen=1000)  # replica error reports
         # Install acks: df name -> replica -> error string | None (ok).
         self.install_acks: dict[str, dict] = {}
@@ -606,6 +618,10 @@ class ComputeController:
             name, addr, self._history_snapshot, self.responses,
             self._nonce_counter,
         )
+        with self._lock:
+            dataflows = list(self._dataflows)
+        for df in dataflows:
+            self.hydration.seed((df, name))
 
     def drop_replica(self, name: str) -> None:
         rc = self.replicas.pop(name, None)
@@ -627,6 +643,7 @@ class ComputeController:
             for per_df in self.arrangement_bytes.values():
                 per_df.pop(name, None)
             self.replica_metrics.pop(name, None)
+        self.hydration.forget_replica(name)
 
     def _history_snapshot(self):
         with self._lock:
@@ -650,6 +667,8 @@ class ComputeController:
         with self._lock:
             self._dataflows[desc.name] = cmd
             self.install_acks.pop(desc.name, None)
+        for r in list(self.replicas):
+            self.hydration.seed((desc.name, r))
         with TRACER.span("controller.create_dataflow",
                          dataflow=desc.name):
             self._broadcast(
@@ -691,7 +710,37 @@ class ComputeController:
             if _time.monotonic() >= deadline:
                 if acks:
                     raise RuntimeError(next(iter(acks.values())))
-                return  # slow hydration is not an error
+                # Slow hydration is still not a DDL error (the install
+                # completes in the background), but it is no longer
+                # SILENT: every connected replica that failed to ack
+                # within the budget transitions to `stalled` in
+                # mz_hydration_statuses (with its attempt count and a
+                # budget-exceeded error), a hydration_stall event lands
+                # in mz_freshness_events, and the stall counter ticks.
+                # The replica's own later hydrating/hydrated report
+                # overrides the stall.
+                from .freshness import (
+                    FRESHNESS,
+                    hydration_stalls_total,
+                )
+
+                for r in connected:
+                    if r in acks:
+                        continue
+                    prev = self.hydration.get((name, r)) or {}
+                    self.hydration.transition(
+                        (name, r), "stalled",
+                        attempts=prev.get("attempts", 0),
+                        error=(
+                            f"hydration exceeded {timeout:.1f}s "
+                            "install budget"
+                        ),
+                    )
+                    FRESHNESS.record_event(
+                        name, r, "hydration_stall"
+                    )
+                    hydration_stalls_total().inc()
+                return
             _time.sleep(poll)
 
     def drop_dataflow(self, name: str) -> None:
@@ -705,6 +754,10 @@ class ComputeController:
             self.recovery_stats.pop(name, None)
             self.arrangement_bytes.pop(name, None)
             self.install_acks.pop(name, None)
+        self.hydration.forget_dataflow(name)
+        from .freshness import FRESHNESS
+
+        FRESHNESS.forget(name)
         self._broadcast(ctp.drop_dataflow(name))
 
     def allow_compaction(self, dataflow: str, since: int) -> None:
@@ -852,6 +905,23 @@ class ComputeController:
                         from ..utils.compile_ledger import LEDGER
 
                         LEDGER.ingest(compiles, process=replica)
+                    fresh = msg.get("freshness")
+                    if fresh:
+                        # Lag records merge into the process-global
+                        # recorder (pid-deduped like spans); status
+                        # transitions land on the hydration board
+                        # (its own lock) keyed by THIS replica.
+                        from .freshness import FRESHNESS
+
+                        lag = fresh.get("lag")
+                        if lag:
+                            FRESHNESS.ingest(lag, process=replica)
+                        for df, entry in (
+                            fresh.get("status") or {}
+                        ).items():
+                            self.hydration.apply(
+                                (df, replica), entry
+                            )
             elif kind == "Status":
                 with self._lock:
                     self.statuses.append(msg)
@@ -894,6 +964,47 @@ class ComputeController:
         with self._lock:
             per = self.frontiers.get(dataflow)
             return max(per.values()) if per else 0
+
+    def least_lagged_replica(self, dataflow: str) -> str | None:
+        """The routing hook (ROADMAP item 5): among CONNECTED replicas,
+        the one with the lowest windowed p50 wallclock lag for this
+        dataflow (coord/freshness.py summaries). Replicas with no lag
+        data yet rank behind those with data; ties break toward the
+        higher reported frontier, then name order. None when no
+        replica is connected."""
+        from .freshness import FRESHNESS
+
+        live = [
+            r
+            for r, rc in self.replicas.items()
+            if rc.connected.is_set()
+        ]
+        if not live:
+            return None
+        summary = FRESHNESS.summary()
+        with self._lock:
+            per_frontier = dict(self.frontiers.get(dataflow, {}))
+        best, best_key = None, None
+        for r in sorted(live):
+            s = summary.get((dataflow, r))
+            lag = (
+                s["p50_ms"]
+                if s is not None and s["samples"]
+                else float("inf")
+            )
+            key = (lag, -per_frontier.get(r, 0))
+            if best_key is None or key < best_key:
+                best, best_key = r, key
+        return best
+
+    def hydration_snapshot(self) -> list:
+        """The mz_hydration_statuses rows: (dataflow, replica, status,
+        since, attempts, last_error), sorted."""
+        return [
+            (key[0], key[1], status, at, attempts, error)
+            for key, status, at, attempts, error, _hist
+            in self.hydration.rows()
+        ]
 
     def wait_frontier(
         self, dataflow: str, past: int, timeout: float | None = None

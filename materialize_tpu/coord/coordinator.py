@@ -408,6 +408,131 @@ class Coordinator:
         )
         return "\n".join(lines)
 
+    def _freshness_analysis_text(self) -> str:
+        """The freshness plane's EXPLAIN ANALYSIS block (ISSUE 15):
+        per installed catalog-named dataflow and replica, the
+        hydration status and the windowed wallclock-lag rollup — the
+        same scoping discipline as the donation/compile blocks
+        (transient SELECT installs are excluded; mz_wallclock_lag_*
+        and mz_hydration_statuses serve everything relationally)."""
+        from .freshness import FRESHNESS
+
+        named = {it.name for it in self.catalog.items.values()}
+        named |= set(self.peekable.values())
+        with self.controller._lock:
+            installed = sorted(
+                n for n in self.controller._dataflows if n in named
+            )
+        lines = ["freshness:"]
+        if not installed:
+            lines.append("  (no dataflows installed)")
+            return "\n".join(lines)
+        summary = FRESHNESS.summary()
+        board = {
+            (df, rep): (status, attempts, error)
+            for df, rep, status, _since, attempts, error
+            in self.controller.hydration_snapshot()
+        }
+        for df in installed:
+            reps = sorted(
+                {rep for (d, rep) in summary if d == df}
+                | {rep for (d, rep) in board if d == df}
+            )
+            if not reps:
+                lines.append(
+                    f"  {df}: pending (no replica report yet)"
+                )
+                continue
+            for rep in reps:
+                status, attempts, error = board.get(
+                    (df, rep), ("pending", 0, "")
+                )
+                line = f"  {df}@{rep}: status={status}"
+                if attempts:
+                    line += f" attempts={attempts}"
+                s = summary.get((df, rep))
+                if s is not None and s["samples"]:
+                    line += (
+                        f" lag_p50_ms={s['p50_ms']:.1f}"
+                        f" lag_p99_ms={s['p99_ms']:.1f}"
+                        f" samples={s['samples']}"
+                    )
+                if error:
+                    line += f" last_error={error!r}"
+                lines.append(line)
+        return "\n".join(lines)
+
+    def health(self) -> dict:
+        """The /api/readyz verdict (the freshness plane's probe,
+        ISSUE 15): ready iff catalog replay had no failures AND (no
+        replicas are registered OR at least one is connected) AND
+        every durable (catalog-installed peekable) dataflow has some
+        connected replica that hydrated — board status `hydrated`, or
+        a reported frontier past 0 — AND, when the freshness_slo_ms
+        SLO is set, no durable dataflow's latest committed lag
+        breaches it. Machine-checkable readiness for `environmentd
+        --recover` drives and rolling restarts."""
+        from ..utils.dyncfg import FRESHNESS_SLO_MS
+        from .freshness import FRESHNESS
+
+        controller = self.controller
+        replicas = dict(controller.replicas)
+        connected = {
+            r for r, rc in replicas.items() if rc.connected.is_set()
+        }
+        dataflows = sorted(set(self.peekable.values()))
+        with controller._lock:
+            frontiers = {
+                df: dict(controller.frontiers.get(df, {}))
+                for df in dataflows
+            }
+        unhydrated = []
+        if replicas:
+            for df in dataflows:
+                ok = False
+                for r in connected:
+                    if (
+                        controller.hydration.status((df, r))
+                        == "hydrated"
+                        or frontiers[df].get(r, 0) > 0
+                    ):
+                        ok = True
+                        break
+                if not ok:
+                    unhydrated.append(df)
+        try:
+            slo = float(FRESHNESS_SLO_MS(COMPUTE_CONFIGS) or 0.0)
+        except (TypeError, ValueError):
+            slo = 0.0
+        breaching = []
+        if slo > 0.0:
+            for df in dataflows:
+                for rep, (_f, lag, _at) in sorted(
+                    FRESHNESS.latest(df).items()
+                ):
+                    if lag > slo:
+                        breaching.append(f"{df}@{rep}")
+        checks = {
+            "catalog_replayed": (
+                int(self.recovery.get("replay_failures", 0)) == 0
+            ),
+            "replicas_connected": (not replicas) or bool(connected),
+            "dataflows_hydrated": not unhydrated,
+            "lag_under_slo": not breaching,
+        }
+        return {
+            "ready": all(checks.values()),
+            "checks": checks,
+            "unhydrated": unhydrated,
+            "breaching": breaching,
+            "replicas": {
+                "registered": len(replicas),
+                "connected": len(connected),
+            },
+            "dataflows": len(dataflows),
+            "freshness_slo_ms": slo,
+        }
+
     # -- durable catalog ----------------------------------------------------
     def _catalog_append(self, record: dict, diff: int) -> None:
         self._net_durable += 1 if diff > 0 else -1
@@ -603,6 +728,12 @@ class Coordinator:
                         raise ValueError(
                             f"expected one of {sorted(LEVELS)}"
                         )
+                if (
+                    plan.name == "freshness_slo_ms"
+                    and plan.value is not None
+                    and float(plan.value) < 0.0
+                ):
+                    raise ValueError("expected a value >= 0")
                 self.update_config({plan.name: plan.value})
             except (TypeError, ValueError) as e:
                 raise PlanError(
@@ -679,6 +810,8 @@ class Coordinator:
                     + self._compile_analysis_text()
                     + "\n"
                     + self.subscribe_hub.analysis_text()
+                    + "\n"
+                    + self._freshness_analysis_text()
                 )
             return ExecuteResult(
                 "text", text=text, columns=("explain",)

@@ -151,6 +151,84 @@ INTROSPECTION_SCHEMAS: dict[str, Schema] = {
     "mz_cluster_replicas": Schema(
         [Column("name", S), Column("connected", I)]
     ),
+    # -- the freshness plane (ISSUE 15) -----------------------------------
+    "mz_wallclock_lag_history": Schema(
+        [
+            # One row per committed span boundary (bounded ring,
+            # coord/freshness.py): how far the committed frontier
+            # trailed the wallclock arrival of its newest input tick.
+            Column("dataflow", S),
+            Column("replica", S),
+            Column("frontier", I),
+            Column("lag_ms", F),
+            Column("at", F),
+        ]
+    ),
+    "mz_wallclock_lag_summary": Schema(
+        [
+            # The windowed quantile rollup (nearest-rank over the last
+            # WINDOW_PER_KEY samples per (dataflow, replica)).
+            Column("dataflow", S),
+            Column("replica", S),
+            Column("samples", I),
+            Column("p50_ms", F),
+            Column("p90_ms", F),
+            Column("p99_ms", F),
+            Column("max_ms", F),
+        ]
+    ),
+    "mz_hydration_statuses": Schema(
+        [
+            # The per-(dataflow, replica) hydration status machine:
+            # pending -> hydrating -> hydrated -> stalled, with the
+            # transition timestamp, build attempt count, and last
+            # error. wait_installed stamps `stalled` when the install
+            # budget expires without an ack (the formerly silent path).
+            Column("dataflow", S),
+            Column("replica", S),
+            Column("status", S),
+            Column("since", F),
+            Column("attempts", I),
+            Column("last_error", S),
+        ]
+    ),
+    "mz_source_statuses": Schema(
+        [
+            # Ingest-loop health per source: running / stalled /
+            # dropped, the last tick and its wallclock, last error.
+            Column("name", S),
+            Column("generator", S),
+            Column("status", S),
+            Column("tick", I),
+            Column("since", F),
+            Column("last_error", S),
+        ]
+    ),
+    "mz_sink_statuses": Schema(
+        [
+            # Persist-sink progress per (sinked dataflow, replica),
+            # derived from the reported frontier and the hydration
+            # board: running once the frontier advanced, stalled when
+            # the dataflow's status machine says so.
+            Column("name", S),
+            Column("sink_shard", S),
+            Column("replica", S),
+            Column("status", S),
+            Column("frontier", I),
+            Column("last_error", S),
+        ]
+    ),
+    "mz_freshness_events": Schema(
+        [
+            # Bounded event ring: freshness_slo_ms breach onsets and
+            # hydration stalls.
+            Column("object", S),
+            Column("replica", S),
+            Column("kind", S),
+            Column("lag_ms", F),
+            Column("at", F),
+        ]
+    ),
 }
 
 
@@ -429,5 +507,92 @@ def snapshot(coord, name: str) -> list[tuple]:
         return [
             (_enc(n), int(rc.connected.is_set()))
             for n, rc in sorted(coord.controller.replicas.items())
+        ]
+    if name == "mz_wallclock_lag_history":
+        from .freshness import FRESHNESS
+
+        return [
+            (_enc(df), _enc(rep), int(frontier), float(lag),
+             float(at))
+            for df, rep, frontier, lag, at in FRESHNESS.history_rows()
+        ]
+    if name == "mz_wallclock_lag_summary":
+        from .freshness import FRESHNESS
+
+        return [
+            (
+                _enc(df),
+                _enc(rep),
+                int(s["samples"]),
+                float(s["p50_ms"]),
+                float(s["p90_ms"]),
+                float(s["p99_ms"]),
+                float(s["max_ms"]),
+            )
+            for (df, rep), s in sorted(FRESHNESS.summary().items())
+        ]
+    if name == "mz_hydration_statuses":
+        return [
+            (_enc(df), _enc(rep), _enc(status), float(since),
+             int(attempts), _enc(error))
+            for df, rep, status, since, attempts, error
+            in coord.controller.hydration_snapshot()
+        ]
+    if name == "mz_source_statuses":
+        return [
+            (
+                _enc(n),
+                _enc(type(src.adapter).__name__),
+                _enc(getattr(src, "status", "running")),
+                src.t,
+                float(getattr(src, "status_at", 0.0)),
+                _enc(getattr(src, "last_error", "")),
+            )
+            for n, src in sorted(coord.sources.items())
+        ]
+    if name == "mz_sink_statuses":
+        # Persist-sink progress, derived: a sinked (materialized-view)
+        # dataflow is `running` on a replica once its reported frontier
+        # advanced, `stalled` when the hydration board says so, and
+        # `starting` before either.
+        sinks = {
+            it.name: it.definition["shard"]
+            for it in coord.catalog.items.values()
+            if it.kind == "materialized-view"
+        }
+        with coord.controller._lock:
+            fsnap = {
+                df: dict(per)
+                for df, per in coord.controller.frontiers.items()
+                if df in sinks
+            }
+        board = {
+            (df, rep): (status, error)
+            for df, rep, status, _since, _att, error
+            in coord.controller.hydration_snapshot()
+            if df in sinks
+        }
+        rows = []
+        for df, shard in sorted(sinks.items()):
+            replicas = set(fsnap.get(df, {})) | {
+                rep for (d, rep) in board if d == df
+            }
+            for rep in sorted(replicas) or [""]:
+                status, error = board.get((df, rep), ("", ""))
+                frontier = fsnap.get(df, {}).get(rep, 0)
+                if status != "stalled":
+                    status = "running" if frontier > 0 else "starting"
+                    error = ""
+                rows.append(
+                    (_enc(df), _enc(shard), _enc(rep), _enc(status),
+                     int(frontier), _enc(error))
+                )
+        return rows
+    if name == "mz_freshness_events":
+        from .freshness import FRESHNESS
+
+        return [
+            (_enc(obj), _enc(rep), _enc(kind), float(lag), float(at))
+            for obj, rep, kind, lag, at in FRESHNESS.events_rows()
         ]
     raise KeyError(name)

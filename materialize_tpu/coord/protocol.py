@@ -240,6 +240,7 @@ def frontiers(
     compiles: list | None = None,
     metrics: list | None = None,
     arrangement_bytes: dict | None = None,
+    freshness: dict | None = None,
 ) -> dict:
     """Replica -> controller frontier report. ``span_epochs`` carries
     each dataflow's monotone COMMITTED span counter (ISSUE 7: the
@@ -264,7 +265,14 @@ def frontiers(
     nonempty/changed, so steady state with tracing off pays nothing.
     ``arrangement_bytes`` carries per-dataflow device-resident bytes
     by spine component (runs/slots/lanes/history) alongside the row
-    counts in ``records`` — the mz_arrangement_sizes surface."""
+    counts in ``records`` — the mz_arrangement_sizes surface.
+    ``freshness`` piggybacks the freshness plane (ISSUE 15):
+    ``{"status": {dataflow: hydration entry}}`` ships on every report
+    path when a status transitioned (the controller's per-(dataflow,
+    replica) board absorbs it), and ``{"lag": [wire records]}``
+    carries wallclock-lag observations from subprocess replicas only
+    (in-process replicas share the process-global recorder; the
+    controller pid-dedupes shipped copies)."""
     msg = {
         "kind": "Frontiers",
         "uppers": uppers,
@@ -286,4 +294,6 @@ def frontiers(
         msg["metrics"] = metrics
     if arrangement_bytes:
         msg["arrangement_bytes"] = arrangement_bytes
+    if freshness:
+        msg["freshness"] = freshness
     return msg

@@ -135,10 +135,19 @@ class ReplicaWorker:
         if ship_observability:
             from ..utils.compile_ledger import LEDGER as _LEDGER
             from ..utils.trace import TRACER as _TRACER
+            from .freshness import FRESHNESS as _FRESHNESS
 
             _TRACER.enable_ship()
             _LEDGER.enable_ship()
+            _FRESHNESS.enable_ship()
         self._ship_observability = bool(ship_observability)
+        # Hydration status machine (freshness plane): per-dataflow
+        # pending -> hydrating -> hydrated -> stalled with attempt
+        # count and last error. Unlike lag records, status entries ship
+        # on EVERY Frontiers report path (dirty-set, keyed by replica
+        # on the controller board — no pid-dedupe question arises).
+        self._hydration: dict[str, dict] = {}
+        self._hydration_dirty: set = set()
         self._metrics_last_ship = 0.0
         self._metrics_last: list | None = None
         self._stop = threading.Event()
@@ -320,6 +329,9 @@ class ReplicaWorker:
                 except Exception as e:  # halt!-analog, scoped to the df
                     self.dataflows.pop(name, None)
                     inst.view.expire()
+                    # A runtime failure is a freshness stall, not just
+                    # a status line: mz_hydration_statuses shows it.
+                    self._set_hydration(name, "stalled", error=repr(e))
                     self._send_status(
                         conn, f"dataflow {name!r} failed: {e!r}"
                     )
@@ -357,15 +369,33 @@ class ReplicaWorker:
         self._recovery_dirty.add(name)
         return rec
 
+    def _set_hydration(
+        self, name: str, status: str, attempts: int = 0, error: str = ""
+    ) -> None:
+        """One hydration status transition, queued for the next
+        Frontiers piggyback (coord/freshness.py status machine)."""
+        from .freshness import status_entry
+
+        self._hydration[name] = status_entry(
+            status, attempts=attempts, error=error
+        )
+        self._hydration_dirty.add(name)
+
     def _build(self, desc: DataflowDescription) -> _Installed:
         """Build (or rebuild) a dataflow. Hydration can race with an
         active-active sibling writing the same sink (SinkConflict) or
         with its compaction moving the as_of (ValueError): both are
         transient — retry against the fresh durable state on the
-        unified ``retry_policy_hydration`` backoff."""
+        unified ``retry_policy_hydration`` backoff. Every attempt is
+        visible in the hydration status machine: hydrating (with the
+        attempt count) while building, hydrated on success, stalled
+        (with the last error) when the retry budget is exhausted or
+        the failure is permanent."""
         from ..utils.retry import policy as _retry_policy
 
         t0 = _time.monotonic()
+        attempts = 0
+        self._set_hydration(desc.name, "hydrating")
         stream = _retry_policy("hydration").stream()
         while True:
             # Render BEFORE subscribing index sources: a render failure
@@ -404,18 +434,34 @@ class ReplicaWorker:
                 self._count_recovery(desc.name, "")["hydrate_ms"] = (
                     (_time.monotonic() - t0) * 1000.0
                 )
+                self._set_hydration(
+                    desc.name, "hydrated", attempts=attempts
+                )
                 return inst
             except (SinkConflict, Fenced, ValueError) as e:
                 # Fenced: an active-active sibling re-registered the sink
                 # writer mid-hydration (epoch ping-pong) — rebuild picks
                 # up the durable state it wrote.
+                attempts += 1
                 for src in index_sources.values():
                     src.reader.expire()  # unsubscribe the failed attempt
                 if not stream.sleep():
+                    self._set_hydration(
+                        desc.name, "stalled",
+                        attempts=attempts, error=repr(e),
+                    )
                     raise
-            except BaseException:
+                self._set_hydration(
+                    desc.name, "hydrating",
+                    attempts=attempts, error=repr(e),
+                )
+            except BaseException as e:
                 for src in index_sources.values():
                     src.reader.expire()
+                self._set_hydration(
+                    desc.name, "stalled",
+                    attempts=attempts, error=repr(e),
+                )
                 raise
 
     def _drain_pending_remaps(self, conn) -> bool:
@@ -598,6 +644,8 @@ class ReplicaWorker:
             inst = self.dataflows.pop(cmd["name"], None)
             self._recovery.pop(cmd["name"], None)
             self._recovery_dirty.discard(cmd["name"])
+            self._hydration.pop(cmd["name"], None)
+            self._hydration_dirty.discard(cmd["name"])
             if inst is not None:
                 inst.view.expire()
         elif kind == "Peek":
@@ -658,6 +706,9 @@ class ReplicaWorker:
             # descriptions fingerprint-match must leave
             # rebuilds == 0 (asserted in tests via mz_recovery).
             self._count_recovery(desc.name, "reconciles")
+            # A reconciled dataflow kept its device state: it IS
+            # hydrated (the new controller's board starts at pending).
+            self._set_hydration(desc.name, "hydrated")
             self._send_installed(conn, desc.name, None)
             return  # reconciliation: unchanged, keep running
         try:
@@ -725,6 +776,7 @@ class ReplicaWorker:
             else:
                 if existing is None:
                     self.dataflows.pop(desc.name, None)
+                self._set_hydration(desc.name, "stalled", error=err)
                 self._send_status(conn, err)
                 self._send_installed(conn, desc.name, err)
         except Exception as e:
@@ -732,6 +784,7 @@ class ReplicaWorker:
             # (scoped halt!; the reference would crash-loop the whole
             # process, we keep sibling dataflows alive).
             err = f"CreateDataflow {desc.name!r} failed: {e!r}"
+            self._set_hydration(desc.name, "stalled", error=err)
             self._send_status(conn, err)
             self._send_installed(conn, desc.name, err)
         else:
@@ -1061,8 +1114,30 @@ class ReplicaWorker:
             spans = TRACER.drain_shippable()
             compiles = LEDGER.drain_shippable()
             metrics = self._metrics_snapshot()
+        # Freshness piggyback: hydration status transitions ship on
+        # EVERY report path (dirty-set — the controller board is keyed
+        # by replica, so in-process replicas can't double-count); lag
+        # records ship only from subprocess replicas (in-process ones
+        # share the process-global FRESHNESS ring, and the controller's
+        # pid-dedupe would drop the copies anyway).
+        freshness = {}
+        if self._hydration_dirty:
+            dirty, self._hydration_dirty = self._hydration_dirty, set()
+            status = {
+                name: dict(self._hydration[name])
+                for name in dirty
+                if name in self._hydration
+            }
+            if status:
+                freshness["status"] = status
+        if self._ship_observability:
+            from .freshness import FRESHNESS
+
+            lag = FRESHNESS.drain_shippable()
+            if lag:
+                freshness["lag"] = lag
         if (changed or donation or sharding or recovery or spans
-                or compiles or metrics):
+                or compiles or metrics or freshness):
             ctp.send_msg(
                 conn,
                 ctp.frontiers(
@@ -1070,6 +1145,7 @@ class ReplicaWorker:
                     donation=donation, sharding=sharding,
                     recovery=recovery, spans=spans, compiles=compiles,
                     metrics=metrics, arrangement_bytes=abytes,
+                    freshness=freshness,
                 ),
             )
             return True

@@ -382,6 +382,13 @@ class GeneratorSource:
         self.tick_interval = tick_interval
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Ingest-loop health (the freshness plane's mz_source_statuses
+        # source): running / stalled (a tick raised; the loop retries
+        # next interval) / stopped, with the transition wallclock and
+        # the last error text.
+        self.status = "running"
+        self.status_at = _time.time()
+        self.last_error = ""
         # Resume: the virtual time is the min subsource upper (all move
         # in lockstep; min is safe after a partial crash).
         self.t = min(w.upper for w in self.writers.values())
@@ -470,6 +477,12 @@ class GeneratorSource:
             else:
                 self._append_batch(w, b, t, t + 1)
 
+    def _set_status(self, status: str, error: str = "") -> None:
+        if status != self.status or error != self.last_error:
+            self.status = status
+            self.status_at = _time.time()
+            self.last_error = error
+
     def tick_once(self) -> int:
         """Advance every subsource by one tick; returns the new frontier."""
         t = self.t
@@ -483,7 +496,17 @@ class GeneratorSource:
 
         def run():
             while not self._stop.is_set():
-                self.tick_once()
+                try:
+                    self.tick_once()
+                except Exception as e:
+                    # A failing tick stalls the source, it does not
+                    # kill the runner: the generator retries next
+                    # interval against fresh durable state, and
+                    # mz_source_statuses shows the stall + error.
+                    self._set_status("stalled", repr(e))
+                else:
+                    if self.status == "stalled":
+                        self._set_status("running")
                 _time.sleep(self.tick_interval)
 
         self._thread = threading.Thread(target=run, daemon=True)
@@ -494,3 +517,4 @@ class GeneratorSource:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        self._set_status("stopped")

@@ -266,11 +266,16 @@ class SubscribeSession:
                 ("snapshot", events, snap_upper, _time.monotonic())
             )
         out.extend(chunks)
+        # Delivery lag shares the freshness plane's single definition
+        # and clock (coord/freshness.lag_ms): monotonic delta between
+        # the chunk's enqueue stamp and this pop, clamped at zero.
+        from .freshness import lag_ms as _lag_ms
+
         now = _time.monotonic()
         for _kind, events, upper, stamp in out:
             self.frontier = max(self.frontier, upper)
             self.delivered += len(events)
-            self.lag_ms = max((now - stamp) * 1000.0, 0.0)
+            self.lag_ms = _lag_ms(stamp, now)
         return out
 
     def poll(self, timeout: float = 5.0):

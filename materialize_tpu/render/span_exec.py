@@ -91,8 +91,14 @@ class SpanExecutor:
         # Reentrancy guard: the dataflow's span_barrier() must no-op
         # for reads issued by this executor's own dispatch/sync path.
         self.in_dispatch = False
-        self._inflight = None  # (flags snapshot, trace rec, deltas)
+        # (flags snapshot, trace rec, deltas, arrival monotonic stamp)
+        self._inflight = None
         self.trace: list[dict] = [] if trace else None
+        # Freshness identity: bench sets the label to the config name
+        # so --measure/--trace lag summaries key per config; the
+        # replica path records through MaintainedView instead.
+        self.freshness_label = getattr(df, "name", "") or "span"
+        self.freshness_replica = "local"
         self.spans_submitted = 0
         self.spans_committed = 0
         self.boundary_syncs = 0  # reads that forced a span boundary
@@ -111,6 +117,7 @@ class SpanExecutor:
         from ..utils.dyncfg import COMPUTE_CONFIGS, SPAN_WINDOW_SPANS
 
         t0 = _time.perf_counter()
+        arrived = _time.monotonic()  # freshness clock (lag_ms)
         gap_ms = (
             0.0
             if self._last_host_free is None
@@ -161,7 +168,12 @@ class SpanExecutor:
                 "donated": self.donate,
             }
             self.spans_submitted += 1
-            prev, self._inflight = self._inflight, (snap, rec, deltas)
+            # Arrival stamp for freshness: the span's inputs were in
+            # hand when submit() was entered (t0 on the same clock).
+            prev, self._inflight = (
+                self._inflight,
+                (snap, rec, deltas, arrived),
+            )
             if prev is not None:
                 prev_deltas = self._complete(prev)
         finally:
@@ -194,7 +206,7 @@ class SpanExecutor:
         """The span boundary: ONE fused flags readback (blocks until
         the span's program finished), then commit — or, on overflow,
         roll back and replay the whole window through check_flags."""
-        snap, rec, deltas = handle
+        snap, rec, deltas, arrived = handle
         r0 = self.df._readbacks
         t0 = _time.perf_counter()
         overflow = self.df.read_flags_snapshot(snap)
@@ -223,6 +235,19 @@ class SpanExecutor:
         if self.trace is not None:
             self.trace.append(rec)
         self.spans_committed += 1
+        # Span-boundary freshness: lag since the committed span's
+        # inputs were submitted (pure host bookkeeping; this function
+        # is RECORDER_PATH-linted, so a d2h sync here fails CI). The
+        # frontier is the monotone committed-span counter — bench
+        # dataflows have no tick timestamps of their own.
+        from ..coord.freshness import FRESHNESS, lag_ms
+
+        FRESHNESS.record(
+            self.freshness_label,
+            self.freshness_replica,
+            self.spans_committed,
+            lag_ms(arrived),
+        )
         from ..utils.trace import TRACER
 
         if TRACER.enabled("debug"):

@@ -240,8 +240,24 @@ def make_handler(coordinator):
                     cluster_exposition(REGISTRY, remote).encode(),
                     "text/plain; version=0.0.4",
                 )
-            elif self.path in ("/api/readyz", "/api/livez"):
-                self._reply(200, b"ready\n", "text/plain")
+            elif self.path == "/api/livez":
+                # Liveness: the process answers HTTP. Always 200 —
+                # restarts are decided by readiness, not liveness.
+                self._reply(200, b"live\n", "text/plain")
+            elif self.path == "/api/readyz":
+                # Readiness (the freshness plane, ISSUE 15): 200 only
+                # when the coordinator's health verdict says catalog
+                # replay succeeded, some replica is connected, every
+                # durable dataflow hydrated, and lag is under the SLO;
+                # otherwise 503 with the full JSON verdict — the
+                # machine-checkable "ready" for `environmentd
+                # --recover` drives and rolling restarts.
+                verdict = coordinator.health()
+                self._reply(
+                    200 if verdict["ready"] else 503,
+                    (json.dumps(verdict) + "\n").encode(),
+                    "application/json",
+                )
             else:
                 self._reply(404, b"not found\n", "text/plain")
 

@@ -530,10 +530,11 @@ class MaintainedView:
         self._upper = 0
         # Pipelined span state (ISSUE 7): the DISPATCHED frontier runs
         # ahead of the committed one by at most one span;
-        # `_inflight_span` holds (flags snapshot, [(t, delta)], target)
-        # until its boundary readback commits it. `span_epoch` is the
-        # monotone span counter peeks and compaction decisions
-        # sequence against (reported with every Frontiers message).
+        # `_inflight_span` holds (flags snapshot, [(t, delta)], target,
+        # input-arrival monotonic stamp) until its boundary readback
+        # commits it. `span_epoch` is the monotone span counter peeks
+        # and compaction decisions sequence against (reported with
+        # every Frontiers message).
         self._dispatched = 0
         self._inflight_span = None
         self._window_ticks: list = []
@@ -950,6 +951,7 @@ class MaintainedView:
                 self._pre_step_multisets = (
                     self.df.capture_basic_multisets()
                 )
+            arrived = _time.monotonic()
             self.df.time = 0
             out = self.df.step({})
             out = self.df.gather_delta(out)
@@ -958,6 +960,7 @@ class MaintainedView:
             self._record_history(0, out)
             self._upper = 1
             self._dispatched = 1
+            self._record_freshness(1, arrived)
             return True
         target = None
         for s in self.sources.values():
@@ -974,6 +977,10 @@ class MaintainedView:
         polled = {
             name: s.fetch_to(target) for name, s in self.sources.items()
         }
+        # Freshness arrival stamp: taken AFTER the fetch completes, so
+        # the recorded lag is the maintenance delay this view adds, not
+        # time spent waiting for input to exist (coord/freshness.py).
+        arrived = _time.monotonic()
         t = target - 1
         if self._sink_finalizes:
             self._pre_step_multisets = (
@@ -987,6 +994,7 @@ class MaintainedView:
         self._record_history(t, out)
         self._upper = target
         self._dispatched = target
+        self._record_freshness(target, arrived)
         return True
 
     # -- pipelined span stepping (ISSUE 7: the async control plane) --------
@@ -1126,6 +1134,7 @@ class MaintainedView:
         ticks = self._gather_ready_ticks(lower, max_ticks, timeout)
         if not ticks:
             return False
+        arrived = _time.monotonic()
         if self.df.time != ticks[0][0]:
             self.df.time = ticks[0][0]
         deltas = self.df.run_steps(
@@ -1145,6 +1154,7 @@ class MaintainedView:
             self._upper = lo
         self._dispatched = lo
         self.span_epoch += 1
+        self._record_freshness(lo, arrived)
         return True
 
     def _step_span_pipelined(
@@ -1162,6 +1172,7 @@ class MaintainedView:
             # No new input: drain the in-flight span so the committed
             # frontier (and peeks waiting on it) still progresses.
             return self._commit_inflight()
+        arrived = _time.monotonic()
         if (
             len(self.df._defer_log)
             >= int(SPAN_WINDOW_SPANS(COMPUTE_CONFIGS))
@@ -1203,7 +1214,7 @@ class MaintainedView:
         entries = [(t, out) for (t, _), out in zip(ticks, deltas)]
         self._window_ticks.extend(entries)
         prev = self._inflight_span
-        self._inflight_span = (snap, entries, ticks[-1][0] + 1)
+        self._inflight_span = (snap, entries, ticks[-1][0] + 1, arrived)
         self._dispatched = ticks[-1][0] + 1
         if prev is not None:
             self._commit_span(prev)
@@ -1216,7 +1227,7 @@ class MaintainedView:
         whole-window rollback+replay."""
         from ...utils.trace import TRACER
 
-        snap, entries, target = handle
+        snap, entries, target, arrived = handle
         t_wall = _time.time()  # host-sync: ok(pure host clock read)
         t0 = _time.perf_counter()
         if self.df.read_flags_snapshot(snap):
@@ -1227,6 +1238,7 @@ class MaintainedView:
             self._record_history(t, out)
             self._upper = t + 1
         self.span_epoch += 1
+        self._record_freshness(target, arrived)
         if TRACER.enabled("debug"):
             # The span-commit cadence record (ISSUE 12): boundary
             # readback wait + publish, at DEBUG so the default level
@@ -1236,6 +1248,21 @@ class MaintainedView:
                 _time.perf_counter() - t0, level="debug",
                 ticks=len(entries), epoch=self.span_epoch,
             )
+
+    def _record_freshness(self, frontier: int, arrived: float) -> None:
+        """Committed-span-boundary lag recording: wallclock_lag_ms =
+        commit time - arrival time of the newest input tick the span
+        covers (one definition: coord/freshness.lag_ms). Pure host
+        bookkeeping — this function is on the host-sync linter's
+        RECORDER_PATH, so a hidden d2h sync here fails CI."""
+        from ...coord.freshness import FRESHNESS, lag_ms
+
+        FRESHNESS.record(
+            getattr(self.df, "name", "") or "df",
+            self.replica_id,
+            frontier,
+            lag_ms(arrived),
+        )
 
     def _commit_inflight(self) -> bool:
         handle, self._inflight_span = self._inflight_span, None

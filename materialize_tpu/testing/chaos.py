@@ -274,6 +274,7 @@ class ChaosReport:
     result: dict = field(default_factory=dict)
     sink: dict = field(default_factory=dict)
     recovery: dict = field(default_factory=dict)
+    hydration: list = field(default_factory=list)
     elapsed_s: float = 0.0
 
     @property
@@ -587,6 +588,33 @@ class ChaosDriver:
                         "never changed, so reconciliation should have "
                         "kept it"
                     )
+        # Freshness-plane status transitions (ISSUE 15): after the
+        # storm heals, every CONNECTED replica's mv_sums must end
+        # hydrated on the controller's hydration board — a terminal
+        # `stalled`/`pending` after a verified-correct run means the
+        # status machine lost a transition.
+        rep.hydration = self.ctl.hydration_snapshot()
+        connected = {
+            r
+            for r, rc in self.ctl.replicas.items()
+            if rc.connected.is_set()
+        }
+        seen = set()
+        for df, r, status, _since, _att, error in rep.hydration:
+            if df != "mv_sums" or r not in connected:
+                continue
+            seen.add(r)
+            if status != "hydrated":
+                rep.failures.append(
+                    f"hydration status of mv_sums on connected "
+                    f"replica {r} ended {status!r} "
+                    f"(error={error!r}); expected hydrated"
+                )
+        for r in connected - seen:
+            rep.failures.append(
+                f"connected replica {r} has no mv_sums hydration "
+                "status entry"
+            )
         return rep
 
     def shutdown(self) -> None:

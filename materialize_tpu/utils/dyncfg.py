@@ -327,6 +327,16 @@ METRICS_REPORT_MS = Config(
     "ship at most once per interval and only when some value changed",
 ).register(COMPUTE_CONFIGS)
 
+FRESHNESS_SLO_MS = Config(
+    "freshness_slo_ms", 0.0,
+    "per-object wallclock-lag SLO in milliseconds (the freshness "
+    "plane, coord/freshness.py): a committed span boundary whose lag "
+    "exceeds it increments mz_freshness_breaches_total, and breach "
+    "ONSETS append to the bounded mz_freshness_events ring; /api/"
+    "readyz reports not-ready while any durable dataflow's latest lag "
+    "breaches. 0 disables (production default: opt in per deployment)",
+).register(COMPUTE_CONFIGS)
+
 TRANSIENT_PEEK_CACHE = Config(
     "transient_peek_cache", 8,
     "memoize slow-path SELECT dataflows by description fingerprint: "

@@ -346,11 +346,30 @@ def run_trace_overhead_gate(gate) -> int:
         return r["ups"]
 
     try:
+        from materialize_tpu.coord.freshness import FRESHNESS
+
+        FRESHNESS.clear()
         window("off")  # warmup: compiles the span program family
         ups = {"debug": [], "off": []}
         for lvl in ("debug", "off", "debug", "off"):
             ups[lvl].append(window(lvl))
         traced, off = max(ups["debug"]), max(ups["off"])
+        # Freshness recording (ISSUE 15) rides the same span-commit
+        # path, so the timed windows above exercised it inside the
+        # same noise budget — but only if it actually recorded.
+        recorded = sum(
+            s["samples"] for s in FRESHNESS.summary().values()
+        )
+        if recorded == 0:
+            findings.append(
+                LintFinding(
+                    "trace-overhead", "freshness",
+                    "the timed windows recorded 0 wallclock-lag "
+                    "samples: SpanExecutor._complete no longer feeds "
+                    "the freshness recorder, so the overhead budget "
+                    "no longer covers it",
+                )
+            )
         # Generous band: the recorder costs microseconds per span;
         # only a structural regression (sync point, per-tick work)
         # shows up as tens of percent. 1-core CI hosts are noisy.
@@ -438,6 +457,23 @@ def run_mz_relations_gate(gate) -> int:
             "SELECT a, b FROM mzrel_t"
         )
         coord.execute("SELECT * FROM mzrel_mv")
+        # Freshness-plane coverage (ISSUE 15): these relations are the
+        # data-plane health surface — dropping one from the registry
+        # must fail the gate, not silently shrink the loop below.
+        required = {
+            "mz_wallclock_lag_history",
+            "mz_hydration_statuses",
+            "mz_source_statuses",
+            "mz_sink_statuses",
+        }
+        for rel in sorted(required - set(INTROSPECTION_SCHEMAS)):
+            findings.append(
+                LintFinding(
+                    "mz-relations", rel,
+                    "required freshness-plane relation is not "
+                    "registered in INTROSPECTION_SCHEMAS",
+                )
+            )
         for rel, schema in sorted(INTROSPECTION_SCHEMAS.items()):
             try:
                 res = coord.execute(f"SELECT * FROM {rel}")

@@ -34,10 +34,14 @@ TOP_KEYS = {
     "trace_id",
     "compiles",
     "perfetto_path",
+    # ISSUE 15: steady-state wallclock-lag quantiles of the best
+    # pipelined window — the freshness plane's per-config figure.
+    "freshness",
 }
 COMPILES_KEYS = {
     "compiles", "misses", "hits", "seconds", "hit_seconds", "by_kind",
 }
+FRESHNESS_KEYS = {"p50_ms", "p99_ms", "max_ms", "samples"}
 MODE_KEYS = {
     "ups",
     "wall_s",
@@ -132,6 +136,22 @@ def test_trace_observability_fields(trace_output, tmp_path):
     assert trace_export.main([str(src), "-o", str(out)]) == 0
     with open(out) as f:
         assert trace_export.validate_chrome_trace(json.load(f)) == []
+
+
+def test_trace_freshness_summary(trace_output):
+    """ISSUE 15: --trace embeds the wallclock-lag summary of the best
+    pipelined window (and each pipelined window carries its own), with
+    samples covering every timed span — proof the span-commit path
+    actually fed the freshness recorder during the bench."""
+    o = trace_output
+    f = o["freshness"]
+    assert set(f) == FRESHNESS_KEYS
+    assert f["samples"] > 0
+    assert 0.0 <= f["p50_ms"] <= f["p99_ms"] <= f["max_ms"]
+    pw = o["pipelined"]["freshness"]
+    assert set(pw) == FRESHNESS_KEYS
+    # Serial mode never rides the span-executor commit path.
+    assert o["serial"]["freshness"]["samples"] == 0
 
 
 def test_every_pipelined_span_has_one_readback(trace_output):

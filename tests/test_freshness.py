@@ -1,0 +1,659 @@
+"""Freshness plane tests (ISSUE 15): wallclock lag histories,
+hydration/source statuses, and readiness probes.
+
+Pins the plane's claims: the lag recorder stays bounded under churn
+and its quantile rollup matches a brute-force recompute; shipped
+records round-trip the wire and pid-dedupe on ingest; SLO breaches
+count every sample but only onsets land in the event ring; the
+hydration status machine transitions pending -> hydrating -> hydrated
+-> stalled with attempt/error carry-over; the four mz_* relations
+serve against a live coordinator + replica; EXPLAIN ANALYSIS grows a
+`freshness:` block; SUBSCRIBE delivery lag shares THE lag definition;
+`least_lagged_replica` picks the less-lagged live replica; and
+/api/readyz flips 503 -> 200 across a recovery boot and back to 503
+on replica SIGKILL (slow lane, with the wait_installed stall
+regression: a budget-exceeded install is `stalled`, never silent)."""
+
+import json
+import os
+import random
+import signal
+import threading
+import time as _time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from materialize_tpu.coord.coordinator import Coordinator
+from materialize_tpu.coord.freshness import (
+    EVENTS_CAPACITY,
+    FRESHNESS,
+    HISTORY_CAPACITY,
+    WINDOW_PER_KEY,
+    FreshnessRecorder,
+    LagRecord,
+    StatusBoard,
+    breaches_total,
+    lag_ms,
+    quantile,
+)
+from materialize_tpu.coord.protocol import PersistLocation
+from materialize_tpu.coord.replica import serve_forever
+from materialize_tpu.storage.persist import (
+    FileBlob,
+    PersistClient,
+    SqliteConsensus,
+)
+from materialize_tpu.utils.dyncfg import COMPUTE_CONFIGS
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """One in-process replica + a coordinator factory over a shared
+    persist location (the test_subscribe idiom)."""
+    loc = PersistLocation(
+        str(tmp_path / "blob"), str(tmp_path / "consensus.db")
+    )
+    port = _free_port()
+    ready = threading.Event()
+    threading.Thread(
+        target=serve_forever, args=(port, loc, "r0", ready), daemon=True
+    ).start()
+    assert ready.wait(10)
+    coords = []
+
+    def make_coord():
+        c = Coordinator(
+            PersistClient(
+                FileBlob(loc.blob_root),
+                SqliteConsensus(loc.consensus_path),
+            ),
+            tick_interval=None,
+        )
+        c.add_replica("r0", ("127.0.0.1", port))
+        coords.append(c)
+        return c
+
+    yield make_coord
+    for c in coords:
+        c.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _reset_freshness_dyncfg():
+    yield
+    COMPUTE_CONFIGS.update({"freshness_slo_ms": None})
+
+
+def _until(pred, timeout: float = 30.0, msg: str = "condition"):
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        _time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# the recorder: one definition, bounded memory, honest quantiles
+# ---------------------------------------------------------------------------
+
+
+class TestLagRecorder:
+    def test_lag_ms_is_the_definition(self):
+        assert lag_ms(10.0, 10.5) == 500.0
+        assert lag_ms(3.0, 3.0) == 0.0
+        # Clamped at zero: a stamp from the future (clock skew across
+        # ingest paths) never produces negative lag.
+        assert lag_ms(_time.monotonic() + 100.0) == 0.0
+
+    def test_history_ring_bounded_under_churn(self):
+        rec = FreshnessRecorder()
+        n = 2 * HISTORY_CAPACITY + 37
+        for i in range(n):
+            rec.record(f"df{i % 5}", "r0", i, float(i % 97))
+        rows = rec.history_rows()
+        assert len(rows) == HISTORY_CAPACITY
+        # The ring keeps the NEWEST observations.
+        assert rows[-1][2] == n - 1
+        for key, win in rec._windows.items():
+            assert len(win) <= WINDOW_PER_KEY, key
+        for s in rec.summary().values():
+            assert s["samples"] <= WINDOW_PER_KEY
+        # Events ring is bounded too.
+        for i in range(2 * EVENTS_CAPACITY):
+            rec.record_event("obj", "r0", "hydration_stall")
+        assert len(rec.events_rows()) == EVENTS_CAPACITY
+
+    def test_quantile_rollup_matches_bruteforce(self):
+        import math
+
+        rng = random.Random(7)
+        vals = [rng.uniform(0.0, 500.0) for _ in range(1377)]
+        rec = FreshnessRecorder()
+        for i, v in enumerate(vals):
+            rec.record("qdf", "r0", i, v)
+        s = rec.summary()[("qdf", "r0")]
+        # Brute-force nearest-rank over the window the rollup covers:
+        # the last WINDOW_PER_KEY samples.
+        window = sorted(vals[-WINDOW_PER_KEY:])
+
+        def brute(q):
+            return window[min(len(window) - 1,
+                              math.ceil(q * len(window)) - 1)]
+
+        assert s["samples"] == WINDOW_PER_KEY
+        assert s["p50_ms"] == pytest.approx(brute(0.50))
+        assert s["p90_ms"] == pytest.approx(brute(0.90))
+        assert s["p99_ms"] == pytest.approx(brute(0.99))
+        assert s["max_ms"] == pytest.approx(window[-1])
+        assert s["last_ms"] == pytest.approx(vals[-1])
+        # Pinned edge semantics of the quantile function itself.
+        assert quantile([], 0.5) == 0.0
+        assert quantile([3.0], 0.99) == 3.0
+        assert quantile([1.0, 2.0], -1.0) == 1.0
+        assert quantile([1.0, 2.0], 2.0) == 2.0
+
+    def test_wire_roundtrip_and_pid_dedupe(self):
+        rec = FreshnessRecorder()
+        rec.enable_ship()
+        rec.record("wd", "r1", 3, 7.5)
+        wire = rec.drain_shippable()
+        assert len(wire) == 1
+        assert rec.drain_shippable() == []  # drained
+        r = LagRecord.from_wire(wire[0])
+        assert (r.dataflow, r.replica, r.frontier, r.lag_ms) == (
+            "wd", "r1", 3, 7.5,
+        )
+        assert r.pid == os.getpid()
+        other = FreshnessRecorder()
+        # Same-pid records are dropped (an in-process replica shares
+        # the ring; ingesting its piggyback would double-count).
+        other.ingest(wire, process="r1")
+        assert other.history_rows() == []
+        foreign = [w[:5] + (w[5] + 1,) for w in wire]
+        other.ingest(foreign, process="r1")
+        assert [row[:4] for row in other.history_rows()] == [
+            ("wd", "r1", 3, 7.5)
+        ]
+        assert other.latest("wd")["r1"][0] == 3
+
+    def test_slo_breach_counts_samples_events_record_onsets(self):
+        COMPUTE_CONFIGS.update({"freshness_slo_ms": 5.0})
+        rec = FreshnessRecorder()
+        before = breaches_total().value
+        rec.record("slo_df", "r0", 1, 10.0)  # onset
+        rec.record("slo_df", "r0", 2, 11.0)  # still breaching
+        rec.record("slo_df", "r0", 3, 1.0)   # recovered
+        rec.record("slo_df", "r0", 4, 12.0)  # second onset
+        assert breaches_total().value - before == 3
+        events = [
+            (obj, kind) for obj, _r, kind, _lag, _at
+            in rec.events_rows()
+        ]
+        assert events == [
+            ("slo_df", "slo_breach"), ("slo_df", "slo_breach")
+        ]
+        # slo <= 0 disables: no counting, and in-breach state clears.
+        COMPUTE_CONFIGS.update({"freshness_slo_ms": None})
+        before = breaches_total().value
+        rec.record("slo_df", "r0", 5, 99999.0)
+        assert breaches_total().value == before
+        assert len(rec.events_rows()) == 2
+
+
+class TestStatusBoard:
+    def test_pending_hydrating_stalled_hydrated_transitions(self):
+        b = StatusBoard()
+        key = ("df", "r0")
+        b.seed(key)
+        assert b.status(key) == "pending"
+        b.seed(key, "hydrated")  # seeding never overwrites
+        assert b.status(key) == "pending"
+        b.transition(key, "hydrating", attempts=1)
+        b.transition(key, "stalled", attempts=3, error="boom")
+        e = b.get(key)
+        assert (e["status"], e["attempts"], e["error"]) == (
+            "stalled", 3, "boom",
+        )
+        # attempts/error carry over when the next transition does not
+        # restate them (wait_installed preserves the replica's count).
+        b.transition(key, "hydrated")
+        e = b.get(key)
+        assert (e["status"], e["attempts"], e["error"]) == (
+            "hydrated", 3, "boom",
+        )
+        assert [s for s, _at in e["history"]] == [
+            "pending", "hydrating", "stalled", "hydrated"
+        ]
+        ts = [at for _s, at in e["history"]]
+        assert ts == sorted(ts)
+
+    def test_rows_and_forget(self):
+        b = StatusBoard()
+        b.seed(("a", "r0"))
+        b.seed(("a", "r1"))
+        b.seed(("b", "r0"))
+        assert [k for k, *_ in b.rows()] == [
+            ("a", "r0"), ("a", "r1"), ("b", "r0")
+        ]
+        b.forget_replica("r0")
+        assert [k for k, *_ in b.rows()] == [("a", "r1")]
+        b.forget_dataflow("a")
+        assert b.rows() == []
+
+    def test_invalid_status_rejected(self):
+        with pytest.raises(AssertionError):
+            StatusBoard().transition(("d", "r"), "exploded")
+
+
+class TestLeastLaggedReplica:
+    def test_picks_less_lagged_live_replica(self):
+        from materialize_tpu.coord.controller import ComputeController
+
+        class _RC:
+            def __init__(self, up=True):
+                self.connected = threading.Event()
+                if up:
+                    self.connected.set()
+
+            def send(self, cmd):
+                pass
+
+            def stop(self):
+                pass
+
+        ctl = ComputeController()
+        try:
+            ctl.replicas["ra"] = _RC()
+            ctl.replicas["rb"] = _RC()
+            ctl.replicas["rc"] = _RC(up=False)
+            for i in range(4):
+                FRESHNESS.record("lld_df", "ra", i, 50.0)
+                FRESHNESS.record("lld_df", "rb", i, 5.0)
+                # The DISCONNECTED replica is fastest but ineligible.
+                FRESHNESS.record("lld_df", "rc", i, 0.1)
+            assert ctl.least_lagged_replica("lld_df") == "rb"
+            # No lag data at all: ties break on frontier then name.
+            assert ctl.least_lagged_replica("lld_other") == "ra"
+            with ctl._lock:
+                ctl.frontiers["lld_other"] = {"ra": 1, "rb": 7}
+            assert ctl.least_lagged_replica("lld_other") == "rb"
+            ctl.replicas.clear()
+            assert ctl.least_lagged_replica("lld_df") is None
+        finally:
+            ctl.replicas.clear()
+            ctl.shutdown()
+            FRESHNESS.forget("lld_df")
+            FRESHNESS.forget("lld_other")
+
+
+# ---------------------------------------------------------------------------
+# live surfaces: relations, EXPLAIN ANALYSIS, health verdict
+# ---------------------------------------------------------------------------
+
+
+class TestLiveSurfaces:
+    def test_relations_serve_and_agree_with_recorder(self, cluster):
+        from materialize_tpu.coord.introspection import (
+            INTROSPECTION_SCHEMAS,
+        )
+
+        coord = cluster()
+        coord.execute(
+            "CREATE TABLE ft (k BIGINT NOT NULL, v BIGINT NOT NULL)"
+        )
+        coord.execute("INSERT INTO ft VALUES (1, 10), (2, 20)")
+        coord.execute("CREATE SOURCE fsrc FROM LOAD GENERATOR counter")
+        coord.execute(
+            "CREATE MATERIALIZED VIEW fmv AS SELECT k, v FROM ft"
+        )
+        assert sorted(
+            coord.execute("SELECT k, v FROM fmv").rows
+        ) == [(1, 10), (2, 20)]
+        # More committed spans -> more lag observations.
+        for i in range(3, 6):
+            coord.execute(f"INSERT INTO ft VALUES ({i}, {i * 10})")
+
+        # Every freshness relation serves SELECT * at declared arity.
+        for rel in (
+            "mz_wallclock_lag_history",
+            "mz_wallclock_lag_summary",
+            "mz_hydration_statuses",
+            "mz_source_statuses",
+            "mz_sink_statuses",
+            "mz_freshness_events",
+        ):
+            res = coord.execute(f"SELECT * FROM {rel}")
+            assert (
+                len(res.columns) == INTROSPECTION_SCHEMAS[rel].arity
+            ), rel
+
+        # Lag history carries fmv@r0 rows with sane values, and the
+        # summary's quantiles are ordered.
+        hist = _until(
+            lambda: [
+                r for r in coord.execute(
+                    "SELECT dataflow, replica, frontier, lag_ms "
+                    "FROM mz_wallclock_lag_history"
+                ).rows
+                if r[0] == "fmv"
+            ],
+            msg="fmv lag history rows",
+        )
+        assert all(
+            r[1] == "r0" and r[2] >= 1 and r[3] >= 0.0 for r in hist
+        )
+        srow = _until(
+            lambda: [
+                r for r in coord.execute(
+                    "SELECT dataflow, replica, samples, p50_ms, "
+                    "p90_ms, p99_ms, max_ms "
+                    "FROM mz_wallclock_lag_summary"
+                ).rows
+                if r[0] == "fmv"
+            ],
+            msg="fmv lag summary row",
+        )[0]
+        assert srow[2] >= 1
+        assert 0.0 <= srow[3] <= srow[4] <= srow[5] <= srow[6]
+
+        # Hydration board: fmv hydrated on r0 (replica piggyback).
+        _until(
+            lambda: ("fmv", "r0", "hydrated") in {
+                tuple(r[:3]) for r in coord.execute(
+                    "SELECT dataflow, replica, status "
+                    "FROM mz_hydration_statuses"
+                ).rows
+            },
+            msg="fmv hydrated status",
+        )
+        # Source status: registered, no error.
+        src = {
+            r[0]: (r[1], r[2], r[5]) for r in coord.execute(
+                "SELECT * FROM mz_source_statuses"
+            ).rows
+        }
+        assert src["fsrc"][0] == "CounterAdapter"
+        assert src["fsrc"][1] in ("running", "stopped")
+        assert src["fsrc"][2] == ""
+        # Sink status: the MV's persist sink is running once its
+        # frontier advanced.
+        _until(
+            lambda: any(
+                r[0] == "fmv" and r[2] == "r0" and r[3] == "running"
+                and r[4] > 0
+                for r in coord.execute(
+                    "SELECT * FROM mz_sink_statuses"
+                ).rows
+            ),
+            msg="fmv sink running",
+        )
+
+        # EXPLAIN ANALYSIS grew the freshness block.
+        txt = coord.execute("EXPLAIN ANALYSIS SELECT k FROM ft").text
+        assert "freshness:" in txt
+        assert "fmv@r0: status=hydrated" in txt
+        assert "lag_p50_ms=" in txt
+
+        # One live replica: it is trivially the least lagged.
+        assert coord.controller.least_lagged_replica("fmv") == "r0"
+
+    def test_health_verdict_and_slo_gate(self, cluster):
+        coord = cluster()
+        _until(
+            lambda: coord.health()["ready"], msg="initial readiness"
+        )
+        coord.execute("CREATE TABLE ht (x BIGINT NOT NULL)")
+        coord.execute("INSERT INTO ht VALUES (1)")
+        coord.execute(
+            "CREATE MATERIALIZED VIEW hmv AS SELECT x FROM ht"
+        )
+        v = _until(
+            lambda: (
+                lambda h: h if h["ready"] else None
+            )(coord.health()),
+            msg="hydrated readiness",
+        )
+        assert v["checks"] == {
+            "catalog_replayed": True,
+            "replicas_connected": True,
+            "dataflows_hydrated": True,
+            "lag_under_slo": True,
+        }
+        assert v["dataflows"] >= 1
+        # An SLO plus a breaching latest observation flips readiness;
+        # SET validates the value and 0 disables again.
+        with pytest.raises(Exception):
+            coord.execute("SET freshness_slo_ms = '-1'")
+        coord.execute("SET freshness_slo_ms = '5'")
+        FRESHNESS.record("hmv", "r0", 999, 50.0)
+        v = coord.health()
+        assert v["ready"] is False
+        assert v["checks"]["lag_under_slo"] is False
+        assert "hmv@r0" in v["breaching"]
+        coord.execute("SET freshness_slo_ms = '0'")
+        assert coord.health()["ready"] is True
+
+    def test_subscribe_lag_shares_the_definition(
+        self, cluster, monkeypatch
+    ):
+        """mz_subscriptions.lag_ms routes through coord/freshness
+        lag_ms — stubbing THE definition changes the subscription's
+        reported lag (one definition, one clock)."""
+        import materialize_tpu.coord.freshness as fr
+
+        coord = cluster()
+        coord.execute("CREATE TABLE sl (x BIGINT NOT NULL)")
+        coord.execute("INSERT INTO sl VALUES (1)")
+        sub = coord.execute("SUBSCRIBE sl").subscription
+        monkeypatch.setattr(
+            fr, "lag_ms", lambda since, now=None: 1234.5
+        )
+        _until(lambda: sub.pop_ready(), msg="subscribe chunk")
+        assert sub.lag_ms == 1234.5
+        sub.close()
+
+
+# ---------------------------------------------------------------------------
+# slow lane: the stall regression and the readyz flip
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestHydrationStallRegression:
+    def test_budget_exceeded_install_is_stalled_not_silent(
+        self, tmp_path
+    ):
+        """The controller.wait_installed regression: a replica that
+        cannot ack within the install budget used to be silently
+        ignored ("slow hydration is not an error"). Now it transitions
+        to `stalled` in mz_hydration_statuses (budget error, stall
+        event, counter tick) and the replica's own later report
+        overrides the stall back to `hydrated`."""
+        from materialize_tpu.testing.chaos import (
+            ReplicaProcess,
+            subprocess_available,
+        )
+
+        if not subprocess_available():
+            pytest.skip("subprocess spawning unavailable")
+        loc = PersistLocation(
+            str(tmp_path / "blob"), str(tmp_path / "consensus.db")
+        )
+        port = _free_port()
+        rp = ReplicaProcess(
+            loc.blob_root, loc.consensus_path, port, rid="r0"
+        )
+        coord = Coordinator(
+            PersistClient(
+                FileBlob(loc.blob_root),
+                SqliteConsensus(loc.consensus_path),
+            ),
+            tick_interval=None,
+        )
+        try:
+            coord.add_replica("r0", ("127.0.0.1", port))
+            coord.execute(
+                "CREATE TABLE st (k BIGINT NOT NULL, v BIGINT "
+                "NOT NULL)"
+            )
+            coord.execute("INSERT INTO st VALUES (1, 10)")
+            assert coord.controller.replicas["r0"].connected.wait(120)
+            # Freeze the replica mid-everything: the TCP session stays
+            # up (the controller still counts it connected and owed an
+            # ack) but it can never build the dataflow.
+            os.kill(rp.proc.pid, signal.SIGSTOP)
+            COMPUTE_CONFIGS.update({
+                "retry_policy_install_wait":
+                    "base=5ms,max=5ms,mult=1,jitter=0,budget=1s",
+            })
+            try:
+                coord.execute(
+                    "CREATE MATERIALIZED VIEW smv AS "
+                    "SELECT k, v FROM st"
+                )
+            finally:
+                COMPUTE_CONFIGS.update(
+                    {"retry_policy_install_wait": None}
+                )
+            e = coord.controller.hydration.get(("smv", "r0"))
+            assert e is not None and e["status"] == "stalled", e
+            assert "install budget" in e["error"]
+            assert ("smv", "r0", "stalled") in {
+                tuple(r[:3]) for r in coord.execute(
+                    "SELECT dataflow, replica, status "
+                    "FROM mz_hydration_statuses"
+                ).rows
+            }
+            assert any(
+                obj == "smv" and kind == "hydration_stall"
+                for obj, _r, kind, _lag, _at
+                in FRESHNESS.events_rows()
+            )
+            # Thaw: the replica builds, hydrates, and its report
+            # overrides the stall.
+            os.kill(rp.proc.pid, signal.SIGCONT)
+            _until(
+                lambda: coord.controller.hydration.status(
+                    ("smv", "r0")
+                ) == "hydrated",
+                timeout=120.0,
+                msg="smv hydrated after SIGCONT",
+            )
+            hist = [
+                s for s, _at in coord.controller.hydration.get(
+                    ("smv", "r0")
+                )["history"]
+            ]
+            assert hist[0] == "pending"
+            assert "stalled" in hist
+            assert hist[-1] == "hydrated"
+            assert coord.execute("SELECT k, v FROM smv").rows == [
+                (1, 10)
+            ]
+        finally:
+            coord.shutdown()
+            rp.stop()
+
+
+def _probe_readyz(port: int):
+    """(status_code, verdict_dict) from /api/readyz; 503 bodies carry
+    the same JSON verdict as 200s."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/readyz", timeout=10
+        ) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _poll_readyz(port: int, want: int, timeout: float):
+    deadline = _time.monotonic() + timeout
+    code, verdict = None, None
+    while _time.monotonic() < deadline:
+        try:
+            code, verdict = _probe_readyz(port)
+        except (urllib.error.URLError, ConnectionError, OSError):
+            _time.sleep(0.2)
+            continue
+        if code == want:
+            return code, verdict
+        _time.sleep(0.2)
+    raise AssertionError(
+        f"readyz never returned {want}; last {code}: {verdict}"
+    )
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestReadyzRecoveryFlip:
+    def test_readyz_gates_recovery_and_replica_kill(self, tmp_path):
+        """The probe contract: 503 while a recovery boot is still
+        re-hydrating its durable dataflows, 200 once every one is
+        hydrated on a connected replica, and back to 503 when the only
+        replica is SIGKILLed."""
+        from materialize_tpu.server.environmentd import Environment
+        from materialize_tpu.testing.chaos import subprocess_available
+
+        if not subprocess_available():
+            pytest.skip("subprocess spawning unavailable")
+        data = str(tmp_path / "envd")
+        env1 = Environment(data, n_replicas=1, tick_interval=None)
+        try:
+            env1.coord.execute(
+                "CREATE TABLE rz (k BIGINT NOT NULL, v BIGINT "
+                "NOT NULL)"
+            )
+            env1.coord.execute("INSERT INTO rz VALUES (1, 10), (2, 20)")
+            env1.coord.execute(
+                "CREATE MATERIALIZED VIEW rzmv AS SELECT k, v FROM rz"
+            )
+            _code, verdict = _poll_readyz(
+                env1.http.port, want=200, timeout=180
+            )
+            assert verdict["ready"] is True
+        finally:
+            env1.shutdown()
+        # Recovery boot on the same data dir (what `environmentd
+        # --recover` drives): the probe must be NOT-ready while the
+        # fresh replica subprocess is still booting/re-hydrating.
+        env2 = Environment(data, n_replicas=1, tick_interval=None)
+        try:
+            code, verdict = _probe_readyz(env2.http.port)
+            assert code == 503, verdict
+            assert verdict["ready"] is False
+            _code, verdict = _poll_readyz(
+                env2.http.port, want=200, timeout=180
+            )
+            assert verdict["checks"]["dataflows_hydrated"] is True
+            assert sorted(
+                env2.coord.execute("SELECT k, v FROM rzmv").rows
+            ) == [(1, 10), (2, 20)]
+            # Kill the only replica: readiness must drop.
+            env2.procs[0].kill()
+            env2.procs[0].wait()
+            _code, verdict = _poll_readyz(
+                env2.http.port, want=503, timeout=60
+            )
+            assert verdict["ready"] is False
+            assert (
+                verdict["checks"]["replicas_connected"] is False
+                or verdict["unhydrated"]
+            )
+        finally:
+            env2.shutdown()

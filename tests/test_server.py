@@ -404,9 +404,27 @@ class TestPgwire:
 
 class TestHttp:
     def test_sql_metrics_ready(self, env):
+        import time as _time
+
         base = f"http://127.0.0.1:{env.http.port}"
-        with urllib.request.urlopen(base + "/api/readyz") as r:
-            assert r.read() == b"ready\n"
+        with urllib.request.urlopen(base + "/api/livez") as r:
+            assert r.read() == b"live\n"
+        # /api/readyz serves the coordinator's JSON health verdict
+        # (503 until the replica session lands — poll briefly).
+        deadline = _time.monotonic() + 30.0
+        while True:
+            try:
+                with urllib.request.urlopen(
+                    base + "/api/readyz"
+                ) as r:
+                    verdict = json.loads(r.read())
+                break
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert _time.monotonic() < deadline
+                _time.sleep(0.05)
+        assert verdict["ready"] is True
+        assert verdict["checks"]["catalog_replayed"] is True
         req = urllib.request.Request(
             base + "/api/sql",
             data=json.dumps(
