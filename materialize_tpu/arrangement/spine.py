@@ -199,7 +199,18 @@ def run_sort_lanes(batch: Batch, key, order: str) -> jnp.ndarray:
 def arrange(
     batch: Batch, key, capacity: int | None = None, order: str = "exact"
 ) -> Arrangement:
-    """Sort+consolidate a batch into an Arrangement (build from scratch)."""
+    """Sort+consolidate a batch into an Arrangement (build from scratch).
+
+    An explicit ``capacity`` snaps to the pow2 quantization menu
+    (ISSUE 16, plan/decisions.quantize_cap): spine run capacities are
+    part of every step program's tier vector, so off-menu sizes would
+    mint program-bank keys no other DDL can share. Growth never
+    shrinks: the snap rounds up, and ``with_capacity`` forbids
+    shrinking below the batch anyway."""
+    if capacity is not None:
+        from ..plan.decisions import quantize_cap
+
+        capacity = quantize_cap(capacity, minimum=batch.capacity)
     key = tuple(key)
     cons = consolidate(batch, include_time=False)
     # consolidate's output is in full-row HASH order; a hash-mode

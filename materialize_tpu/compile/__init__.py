@@ -1,0 +1,19 @@
+"""The compile plane: the persistent AOT program bank (ISSUE 16).
+
+PR 9's compile ledger made every XLA compile a counted record and
+printed ``bankable_seconds`` — the wall a cross-process program bank
+keyed by ``(kind, fingerprint, tier)`` would recover. This package IS
+that bank:
+
+- :mod:`bank` — blob-backed serialized-executable store. Every
+  ``ledger_jit`` site becomes a bank lookup point when a bank is
+  configured: first sight of a key loads the serialized executable
+  (``bank_hit``, milliseconds) instead of recompiling (seconds to
+  minutes), and misses are compiled ahead-of-time and written back.
+- :mod:`worker` — the background compile worker behind async DDL:
+  ``CREATE INDEX`` / ``CREATE MATERIALIZED VIEW`` serves immediately
+  in generic merge mode while the worker pre-compiles the specialized
+  program into the bank; the replica hot-swaps at a span boundary.
+"""
+
+from .bank import ProgramBank, configure_bank, get_bank  # noqa: F401

@@ -1,0 +1,137 @@
+"""Background compile worker (ISSUE 16 tentpole c).
+
+``CREATE INDEX`` / ``CREATE MATERIALIZED VIEW`` should not serialize
+the session behind a multi-second XLA compile. With async compile on
+(dyncfg ``enable_async_compile``) and a program bank configured, the
+replica installs a fresh DDL's dataflow in GENERIC MERGE MODE
+(``out_slots=0`` — the every-step run-0 merge program, correct for any
+state size, just O(run0) per step instead of O(delta)) and hands this
+worker the description. The worker renders the SPECIALIZED dataflow
+off-thread, drives one warm-up step so its step program compiles
+through the banked ``ledger_jit`` path (the compile lands in the bank),
+and marks the task done. The replica's worker loop notices at a span
+boundary, drains in-flight spans (the PR 4 ``sync_spans`` sequencing —
+no half-applied carry), and rebuilds the dataflow from durable state;
+the rebuild's compiles come back as bank hits, so the swap costs a
+re-hydration, not a compile wall.
+
+The warm-up compiles the base-tier step program. Tiers the warm-up
+cannot predict (post-hydration growth) compile at swap time and are
+written back — the bank converges; the swap never blocks correctness
+on warm-up completeness.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time as _time
+
+
+class CompileTask:
+    __slots__ = ("desc", "queued_at", "done_at", "error")
+
+    def __init__(self, desc):
+        self.desc = desc
+        self.queued_at = _time.time()
+        self.done_at: float | None = None
+        self.error: str = ""
+
+    @property
+    def done(self) -> bool:
+        return self.done_at is not None
+
+
+class CompileWorker:
+    """One daemon thread per replica process, started lazily on the
+    first async install. Failures are recorded on the task, never
+    raised — a warm-up that cannot compile (exotic expr, serializer
+    limits) just means the swap pays the compile itself."""
+
+    def __init__(self):
+        self._q: queue.Queue = queue.Queue()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self.tasks: dict[str, CompileTask] = {}
+
+    def submit(self, desc) -> CompileTask:
+        task = CompileTask(desc)
+        with self._lock:
+            self.tasks[desc.name] = task
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name="mz-compile-worker",
+                )
+                self._thread.start()
+        self._q.put(task)
+        return task
+
+    def pop_ready(self) -> list[CompileTask]:
+        """Completed tasks, removed — the replica loop's swap poll."""
+        with self._lock:
+            ready = [t for t in self.tasks.values() if t.done]
+            for t in ready:
+                self.tasks.pop(t.desc.name, None)
+        return ready
+
+    def pending(self) -> list[str]:
+        with self._lock:
+            return sorted(
+                n for n, t in self.tasks.items() if not t.done
+            )
+
+    def _run(self) -> None:
+        while True:
+            try:
+                task = self._q.get(timeout=30)
+            except queue.Empty:
+                return  # idle worker retires; next submit restarts
+            try:
+                warm_programs(task.desc)
+            except Exception as e:
+                task.error = repr(e)
+            task.done_at = _time.time()
+
+
+def warm_programs(desc) -> None:
+    """Render the specialized dataflow for ``desc`` and compile its
+    base-tier step program through the banked ledger_jit path. The
+    shadow dataflow holds no durable state and is dropped on return —
+    only the bank entry (and the ledger record) survive."""
+    import numpy as np
+
+    from ..render.dataflow import Dataflow
+    from ..repr.batch import Batch
+    from ..repr.schema import DIFF_DTYPE, TIME_DTYPE
+
+    df = Dataflow(desc.expr, name=desc.name)
+    inputs = {}
+    for name, schema in _source_schemas(desc).items():
+        inputs[name] = Batch.from_numpy(
+            schema,
+            [np.zeros(0, dtype=c.dtype) for c in schema.columns],
+            np.zeros(0, dtype=TIME_DTYPE),
+            np.zeros(0, dtype=DIFF_DTYPE),
+        )
+    if inputs:
+        df.run_steps([inputs])
+
+
+def _source_schemas(desc) -> dict:
+    """name -> Schema for every input the step program reads. Source
+    imports carry (shard_id, schema) pairs; index imports are skipped
+    (the shadow dataflow has no publisher to subscribe to — their
+    programs compile at swap time)."""
+    out = {}
+    for name, imp in getattr(desc, "source_imports", {}).items():
+        schema = imp[1] if isinstance(imp, tuple) else getattr(
+            imp, "schema", None
+        )
+        if schema is not None:
+            out[name] = schema
+    if getattr(desc, "index_imports", None):
+        # A dataflow reading another index needs live IndexSources to
+        # step; warm only pure-source dataflows.
+        return {}
+    return out

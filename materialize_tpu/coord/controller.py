@@ -560,6 +560,10 @@ class ComputeController:
         # TRACER / LEDGER (pid-deduped), not controller state.
         self.arrangement_bytes: dict[str, dict[str, dict]] = {}
         self.replica_metrics: dict[str, list] = {}
+        # Async-compile hot-swap states (ISSUE 16, df -> replica ->
+        # {"state": pending|swapped|swap-failed, timestamps}): the
+        # EXPLAIN ANALYSIS `pending_swap` / mz_program_bank surface.
+        self.swap_states: dict[str, dict[str, dict]] = {}
         # Freshness plane (ISSUE 15): the per-(dataflow, replica)
         # hydration status board (pending -> hydrating -> hydrated ->
         # stalled, with bounded transition history). Seeded "pending"
@@ -753,6 +757,7 @@ class ComputeController:
             self.sharding_verdicts.pop(name, None)
             self.recovery_stats.pop(name, None)
             self.arrangement_bytes.pop(name, None)
+            self.swap_states.pop(name, None)
             self.install_acks.pop(name, None)
         self.hydration.forget_dataflow(name)
         from .freshness import FRESHNESS
@@ -884,6 +889,10 @@ class ComputeController:
                             "arrangement_bytes", {}
                         ).items():
                             self.arrangement_bytes.setdefault(df, {})[
+                                replica
+                            ] = v
+                        for df, v in msg.get("swaps", {}).items():
+                            self.swap_states.setdefault(df, {})[
                                 replica
                             ] = v
                         if "metrics" in msg:

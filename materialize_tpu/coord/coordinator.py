@@ -379,7 +379,11 @@ class Coordinator:
         session-scoped generated names that would make EXPLAIN output
         nondeterministic; mz_compile_log serves EVERY record
         relationally). `hit` seconds are the wall a cross-process
-        program bank (ROADMAP 4) would recover."""
+        program bank (ROADMAP 4) would recover. With the bank live
+        (ISSUE 16) the block also reports ``bank_hit`` serves (NOT
+        compiles — deserialized executables), ``bank_miss`` write-backs,
+        the compile seconds the hits skipped, and any async hot-swaps
+        still pending."""
         from ..utils.compile_ledger import LEDGER
 
         named = {it.name for it in self.catalog.items.values()}
@@ -388,9 +392,16 @@ class Coordinator:
             installed = {
                 n for n in self.controller._dataflows if n in named
             }
+            pending = sorted(
+                df
+                for df, per in self.controller.swap_states.items()
+                if df in named and any(
+                    e.get("state") == "pending" for e in per.values()
+                )
+            )
         s = LEDGER.summary(names=installed)
         lines = ["compiles:"]
-        if not s["compiles"]:
+        if not (s["compiles"] or s["bank_hits"] or pending):
             lines.append("  (no compiles recorded for installed "
                          "dataflows)")
             return "\n".join(lines)
@@ -406,6 +417,17 @@ class Coordinator:
             f"seconds={s['seconds']:.3f} "
             f"bankable_seconds={s['hit_seconds']:.3f}"
         )
+        if s["bank_hits"] or s["bank_misses"]:
+            lines.append(
+                f"  bank: bank_hit={s['bank_hits']} "
+                f"bank_miss={s['bank_misses']} "
+                f"seconds_recovered="
+                f"{s['bank_seconds_recovered']:.3f}"
+            )
+        if pending:
+            lines.append(
+                "  pending_swap=[" + ", ".join(pending) + "]"
+            )
         return "\n".join(lines)
 
     def _freshness_analysis_text(self) -> str:
@@ -2147,6 +2169,17 @@ class Coordinator:
                 lvl = TRACE_LEVEL.default
             if lvl in LEVELS:
                 TRACER.set_level(lvl)
+        if "program_bank_path" in values:
+            # Re-point this process's program bank (ISSUE 16);
+            # replicas re-point theirs when the UpdateConfiguration
+            # command reaches them.
+            from ..compile.bank import configure_bank
+            from ..utils.dyncfg import PROGRAM_BANK_PATH
+
+            path = values["program_bank_path"]
+            if path is None:  # reset-to-default delta
+                path = PROGRAM_BANK_PATH.default
+            configure_bank(path or None)
         self.controller.update_configuration(dict(values))
 
     def shutdown(self) -> None:

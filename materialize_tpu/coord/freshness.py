@@ -58,7 +58,14 @@ HISTORY_CAPACITY = 4096
 WINDOW_PER_KEY = 512
 EVENTS_CAPACITY = 256
 
-HYDRATION_STATUSES = ("pending", "hydrating", "hydrated", "stalled")
+# "swapping" (ISSUE 16): an async-compiled dataflow is mid hot-swap —
+# the generic merge-mode program served until a span boundary, and the
+# specialized rebuild is hydrating from durable shards. Readiness
+# probes treat it like hydrating (health() also accepts frontier > 0,
+# so a swap never flips a serving dataflow unready).
+HYDRATION_STATUSES = (
+    "pending", "hydrating", "hydrated", "stalled", "swapping"
+)
 
 
 def lag_ms(since: float, now: float | None = None) -> float:

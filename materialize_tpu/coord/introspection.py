@@ -141,6 +141,22 @@ INTROSPECTION_SCHEMAS: dict[str, Schema] = {
             Column("cache", S),
         ]
     ),
+    "mz_program_bank": Schema(
+        [
+            # The persistent AOT program bank (ISSUE 16): one row per
+            # banked executable (kind/fingerprint/tier parsed from the
+            # entry filename, size and store time from stat) plus one
+            # row per async hot-swap in flight (kind="swap",
+            # dataflow=the DDL, state=pending|swapped|swap-failed).
+            Column("kind", S),
+            Column("dataflow", S),
+            Column("fingerprint", S),
+            Column("tier", S),
+            Column("bytes", I),
+            Column("state", S),
+            Column("stored_at", F),
+        ]
+    ),
     "mz_slow_statements": Schema(
         [
             Column("sql", S),
@@ -408,6 +424,56 @@ def snapshot(coord, name: str) -> list[tuple]:
                         (_enc("dataflow"), _enc(df), _enc(rep),
                          _enc(metric), float(v.get(metric, 0)))
                     )
+        # Compile breakdown (ISSUE 16): how much of recovery's compile
+        # wall the program bank absorbed — bank hits/misses and the
+        # compile seconds the hits skipped, deployment-wide (ledger
+        # ingests replica records via the Frontiers piggyback).
+        from ..utils.compile_ledger import LEDGER
+
+        summ = LEDGER.summary()
+        for metric in ("bank_hits", "bank_misses",
+                       "bank_seconds_recovered"):
+            rows.append(
+                (_enc("compile"), _enc(""), _enc(""),
+                 _enc(metric), float(summ.get(metric, 0)))
+            )
+        return rows
+    if name == "mz_program_bank":
+        from ..compile.bank import get_bank
+
+        rows = []
+        bank = get_bank()
+        if bank is not None:
+            for e in bank.entries():
+                rows.append(
+                    (
+                        _enc(e["kind"]),
+                        _enc(""),
+                        _enc(e["fingerprint"]),
+                        _enc(e["tier"]),
+                        int(e["bytes"]),
+                        _enc("stored"),
+                        float(e["stored_at"]),
+                    )
+                )
+        with coord.controller._lock:
+            swaps = {
+                df: dict(per)
+                for df, per in coord.controller.swap_states.items()
+            }
+        for df, per in sorted(swaps.items()):
+            for _rep, entry in sorted(per.items()):
+                rows.append(
+                    (
+                        _enc("swap"),
+                        _enc(df),
+                        _enc(""),
+                        _enc(""),
+                        0,
+                        _enc(str(entry.get("state", ""))),
+                        float(entry.get("queued_at", 0.0)),
+                    )
+                )
         return rows
     if name == "mz_subscriptions":
         # The push plane's live sessions (ISSUE 11): per session, the

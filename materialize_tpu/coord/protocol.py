@@ -241,6 +241,7 @@ def frontiers(
     metrics: list | None = None,
     arrangement_bytes: dict | None = None,
     freshness: dict | None = None,
+    swaps: dict | None = None,
 ) -> dict:
     """Replica -> controller frontier report. ``span_epochs`` carries
     each dataflow's monotone COMMITTED span counter (ISSUE 7: the
@@ -272,7 +273,11 @@ def frontiers(
     replica) board absorbs it), and ``{"lag": [wire records]}``
     carries wallclock-lag observations from subprocess replicas only
     (in-process replicas share the process-global recorder; the
-    controller pid-dedupes shipped copies)."""
+    controller pid-dedupes shipped copies). ``swaps`` piggybacks
+    async-compile hot-swap transitions (ISSUE 16:
+    ``{dataflow: {"state": pending|swapped|swap-failed, ...}}``),
+    shipped only on change — the EXPLAIN ANALYSIS ``pending_swap``
+    and mz_program_bank surface."""
     msg = {
         "kind": "Frontiers",
         "uppers": uppers,
@@ -296,4 +301,6 @@ def frontiers(
         msg["arrangement_bytes"] = arrangement_bytes
     if freshness:
         msg["freshness"] = freshness
+    if swaps:
+        msg["swaps"] = swaps
     return msg

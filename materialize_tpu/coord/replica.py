@@ -156,6 +156,16 @@ class ReplicaWorker:
         # invalidates our device-resident codes too: queue the remaps
         # and rebuild from the worker loop (single-threaded owner).
         self._pending_remaps: list[dict] = []
+        # Async compile + hot-swap (ISSUE 16): dataflows currently
+        # serving their GENERIC merge-mode program while the compile
+        # worker banks the specialized one. name -> swap entry
+        # ("pending" | "swapped" with timestamps), piggybacked on
+        # Frontiers whenever it changes (the EXPLAIN/mz_program_bank
+        # pending_swap surface). The CompileWorker thread is created
+        # lazily on the first async install.
+        self._pending_swap: dict[str, dict] = {}
+        self._swap_dirty: set = set()
+        self._compile_worker = None
         from ..utils.lockcheck import tracked_lock
 
         self._remap_lock = tracked_lock("replica.remap")
@@ -336,6 +346,8 @@ class ReplicaWorker:
                         conn, f"dataflow {name!r} failed: {e!r}"
                     )
                     worked = True
+            if self._pending_swap:
+                worked |= self._maybe_swap(conn)
             try:
                 worked |= self._serve_peeks(conn)
             except DictExhausted:
@@ -348,8 +360,21 @@ class ReplicaWorker:
             if not worked:
                 _time.sleep(0.002)  # park
 
-    def _make_dataflow(self, desc: DataflowDescription):
+    def _make_dataflow(
+        self, desc: DataflowDescription, generic: bool = False
+    ):
         if self.workers <= 1:
+            # generic=True (async compile, ISSUE 16): force merge-mode
+            # output ingest (out_slots=0) — the every-step run-0 merge
+            # program is correct at any state size and is the cheapest
+            # program family to have banked, so a fresh DDL serves
+            # immediately while the specialized slotted/donated
+            # program compiles in the background.
+            if generic:
+                return Dataflow(
+                    desc.expr, name=desc.name,
+                    force_merge_ingest=True,
+                )
             return Dataflow(desc.expr, name=desc.name)
         from ..parallel.mesh import make_mesh
         from ..render.dataflow import ShardedDataflow
@@ -381,7 +406,9 @@ class ReplicaWorker:
         )
         self._hydration_dirty.add(name)
 
-    def _build(self, desc: DataflowDescription) -> _Installed:
+    def _build(
+        self, desc: DataflowDescription, generic: bool = False
+    ) -> _Installed:
         """Build (or rebuild) a dataflow. Hydration can race with an
         active-active sibling writing the same sink (SinkConflict) or
         with its compaction moving the as_of (ValueError): both are
@@ -401,7 +428,7 @@ class ReplicaWorker:
             # Render BEFORE subscribing index sources: a render failure
             # must not leak subscribers onto publishers (each publisher
             # step would copy its delta to the orphan forever).
-            df = self._make_dataflow(desc)
+            df = self._make_dataflow(desc, generic=generic)
             index_sources: dict = {}
             try:
                 # Index imports resolve against dataflows ALREADY
@@ -593,6 +620,83 @@ class ReplicaWorker:
             self.dataflows[dn] = self._build(dinst.desc)
             self._count_recovery(dn, "rebuilds")
 
+    # -- async compile + hot-swap (ISSUE 16) -------------------------------
+    def _async_eligible(self, desc: DataflowDescription) -> bool:
+        """Fresh-install DDLs take the generic-then-swap path only
+        when async compile is on AND a program bank is configured
+        (without the bank the swap's rebuild would pay the very
+        compile wall we deferred, on the worker loop). SPMD replicas
+        keep synchronous installs — the trial-render/prover gate
+        already decides their program family."""
+        from ..utils.dyncfg import COMPUTE_CONFIGS, ENABLE_ASYNC_COMPILE
+
+        if not ENABLE_ASYNC_COMPILE(COMPUTE_CONFIGS):
+            return False
+        if self.workers > 1:
+            return False
+        from ..compile.bank import get_bank
+
+        return get_bank() is not None
+
+    def _ensure_compile_worker(self):
+        if self._compile_worker is None:
+            from ..compile.worker import CompileWorker
+
+            self._compile_worker = CompileWorker()
+        return self._compile_worker
+
+    def _mark_swap(self, name: str, state: str, error: str = "") -> None:
+        entry = self._pending_swap.get(name)
+        if entry is None:
+            entry = {"queued_at": _time.time()}
+        entry["state"] = state
+        if error:
+            entry["error"] = error
+        if state == "swapped":
+            entry["swapped_at"] = _time.time()
+        self._pending_swap[name] = entry
+        self._swap_dirty.add(name)
+
+    def _maybe_swap(self, conn) -> bool:
+        """Hot-swap poll, run from the worker loop (single-threaded
+        owner of the dataflow map): for each compile task the worker
+        finished, drain in-flight spans (the PR 4 sync_spans barrier —
+        the swap lands ON a committed span boundary, never through a
+        half-applied carry) and rebuild the dataflow from durable
+        state; the rebuild's render takes the specialized path and its
+        compiles come back as bank hits."""
+        if self._compile_worker is None:
+            return False
+        ready = self._compile_worker.pop_ready()
+        if not ready:
+            return False
+        did = False
+        for task in ready:
+            name = task.desc.name
+            inst = self.dataflows.get(name)
+            entry = self._pending_swap.get(name)
+            if (
+                inst is None
+                or entry is None
+                or entry.get("state") != "pending"
+            ):
+                continue
+            try:
+                inst.view.sync_spans()
+                self._set_hydration(name, "swapping")
+                self._rebuild_cascade(name)
+                self._mark_swap(name, "swapped", error=task.error)
+            except Exception as e:
+                # A failed swap leaves the generic program serving —
+                # correct results at merge-mode cost. Surface, don't
+                # crash the loop.
+                self._mark_swap(name, "swap-failed", error=repr(e))
+                self._send_status(
+                    conn, f"hot-swap of {name!r} failed: {e!r}"
+                )
+            did = True
+        return did
+
     def _send_installed(self, conn, name: str, error) -> None:
         """Install ack: the DDL response path waits on these so a bad
         plan surfaces AT CREATE TIME instead of as a later "no such
@@ -646,6 +750,10 @@ class ReplicaWorker:
             self._recovery_dirty.discard(cmd["name"])
             self._hydration.pop(cmd["name"], None)
             self._hydration_dirty.discard(cmd["name"])
+            self._pending_swap.pop(cmd["name"], None)
+            self._swap_dirty.discard(cmd["name"])
+            if self._compile_worker is not None:
+                self._compile_worker.tasks.pop(cmd["name"], None)
             if inst is not None:
                 inst.view.expire()
         elif kind == "Peek":
@@ -679,6 +787,16 @@ class ReplicaWorker:
 
             self.config.update(cmd["params"])
             COMPUTE_CONFIGS.update(cmd["params"])
+            if "program_bank_path" in cmd["params"]:
+                # Re-point THIS process's program bank (ISSUE 16) —
+                # subprocess replicas don't share the coordinator's.
+                from ..compile.bank import configure_bank
+                from ..utils.dyncfg import PROGRAM_BANK_PATH
+
+                path = cmd["params"]["program_bank_path"]
+                if path is None:  # reset-to-default delta
+                    path = PROGRAM_BANK_PATH.default
+                configure_bank(path or None)
             if "trace_level" in cmd["params"]:
                 # The trace_level dyncfg drives THIS process's span
                 # recorder too (log_filter propagation, ISSUE 12).
@@ -717,6 +835,18 @@ class ReplicaWorker:
                 # its arrangement (subscribers hold direct view
                 # references).
                 self._rebuild_cascade(desc.name, new_desc=desc)
+            elif self._async_eligible(desc):
+                # Async compile (ISSUE 16): serve NOW on the generic
+                # merge-mode program (correct at any size), hand the
+                # specialized program to the background compile
+                # worker, and hot-swap at a span boundary when it
+                # lands in the bank.
+                self.dataflows[desc.name] = self._build(
+                    desc, generic=True
+                )
+                self._count_recovery(desc.name, "installs")
+                self._mark_swap(desc.name, "pending")
+                self._ensure_compile_worker().submit(desc)
             else:
                 self.dataflows[desc.name] = self._build(desc)
                 self._count_recovery(desc.name, "installs")
@@ -1136,8 +1266,19 @@ class ReplicaWorker:
             lag = FRESHNESS.drain_shippable()
             if lag:
                 freshness["lag"] = lag
+        # Hot-swap state transitions (ISSUE 16) ride the same way:
+        # only when changed (queued, swapped, failed) — the EXPLAIN
+        # ANALYSIS pending_swap / mz_program_bank surface.
+        swaps = {}
+        if self._swap_dirty:
+            dirty, self._swap_dirty = self._swap_dirty, set()
+            swaps = {
+                name: dict(self._pending_swap[name])
+                for name in dirty
+                if name in self._pending_swap
+            }
         if (changed or donation or sharding or recovery or spans
-                or compiles or metrics or freshness):
+                or compiles or metrics or freshness or swaps):
             ctp.send_msg(
                 conn,
                 ctp.frontiers(
@@ -1145,7 +1286,7 @@ class ReplicaWorker:
                     donation=donation, sharding=sharding,
                     recovery=recovery, spans=spans, compiles=compiles,
                     metrics=metrics, arrangement_bytes=abytes,
-                    freshness=freshness,
+                    freshness=freshness, swaps=swaps,
                 ),
             )
             return True

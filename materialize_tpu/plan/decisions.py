@@ -26,6 +26,37 @@ from .lir import (
 # default compaction cadence (_DataflowBase._compact_every).
 INGEST_RING_SLOTS = 8
 
+# Capacity-tier quantization (ISSUE 16 tentpole b): every capacity a
+# program specializes on — state tiers, slot/join/letrec caps, spine
+# run capacities, batch tiers — snaps to this pow2 menu. Distinct DDLs
+# that differ only in requested size then land on the SAME
+# (fingerprint, tier-vector) program-bank key, turning first-sight
+# compiles into bank hits across the catalog. The floor matches
+# repr/batch.capacity_tier's default minimum.
+QUANT_MENU_FLOOR = 256
+
+
+def quantize_cap(n: int, minimum: int = QUANT_MENU_FLOOR) -> int:
+    """Snap a requested capacity up to its pow2 menu rung. Shared by
+    the render layer (Dataflow/_RenderContext/_grow_for targets) and
+    the arrangement layer (spine run capacities) — the single source
+    of truth that makes bank keys size-stable."""
+    cap = max(int(minimum), 1)
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def quantization_menu(
+    floor: int = QUANT_MENU_FLOOR, ceiling: int = 1 << 24
+) -> tuple:
+    """The full rung menu (doc/EXPLAIN surface)."""
+    out, cap = [], max(int(floor), 1)
+    while cap <= ceiling:
+        out.append(cap)
+        cap *= 2
+    return tuple(out)
+
 
 def _spmd_gate(mode: str, spmd: bool, spmd_safe) -> str:
     """The SPMD slot gate (ISSUE 9): under SPMD, append-slot ingest is

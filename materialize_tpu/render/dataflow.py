@@ -105,13 +105,20 @@ class _RenderContext:
     def __init__(self, source_schemas: dict, num_shards: int = 1,
                  axis_name: str = WORKER_AXIS, slot_cap: int = 256,
                  join_cap: int = 1024, state_cap: int = 256,
-                 spmd_safe=None):
+                 spmd_safe=None, force_merge_ingest: bool = False):
         self.source_schemas = source_schemas
         # Initial capacity tier for every stateful operator's
         # arrangements. Overflow growth doubles tiers as needed; callers
         # that know their steady-state size pass a larger tier up front
         # to skip the overflow->grow->recompile ladder (each rung is a
-        # fresh XLA compile of the step program).
+        # fresh XLA compile of the step program). Caps snap to the pow2
+        # quantization menu (ISSUE 16): size-only DDL differences must
+        # not mint new program-bank keys.
+        from ..plan.decisions import quantize_cap
+
+        state_cap = quantize_cap(state_cap)
+        slot_cap = quantize_cap(slot_cap)
+        join_cap = quantize_cap(join_cap)
         self.state_cap = state_cap
         # Ingest-mode decision for operator-state spines
         # (plan/decisions.py state_ingest_mode, the EXPLAIN-visible
@@ -125,9 +132,15 @@ class _RenderContext:
         from ..plan.decisions import INGEST_RING_SLOTS, state_ingest_mode
 
         self.spmd_safe = spmd_safe
+        # force_merge_ingest (ISSUE 16 async compile): the GENERIC
+        # program family — merge ingest regardless of the dyncfg/auto
+        # decision, so a fresh DDL's immediately-installed dataflow is
+        # the cheapest-to-have-banked program while the specialized
+        # one compiles in the background.
         self.ingest_slots = (
             INGEST_RING_SLOTS
-            if state_ingest_mode(
+            if not force_merge_ingest
+            and state_ingest_mode(
                 state_cap, spmd=num_shards > 1, spmd_safe=spmd_safe
             )
             == "append_slot"
@@ -1104,7 +1117,14 @@ class _DataflowBase:
         doubling by default, or straight to ``target`` in a single pad
         (callers applying known steady-state tiers up front skip the
         doubling ladder, whose per-rung pad programs each cost a compile
-        + dispatch through the TPU tunnel)."""
+        + dispatch through the TPU tunnel). Explicit targets snap to
+        the pow2 quantization menu (ISSUE 16) so applied bench tiers
+        land on bankable program keys; doubling from a quantized base
+        stays on the menu by construction."""
+        if target is not None:
+            from ..plan.decisions import quantize_cap
+
+            target = quantize_cap(target)
         if key[0] == "state":
             _, slot, part = key
             parts = list(self.states[slot])
@@ -2215,7 +2235,8 @@ class Dataflow(_DataflowBase):
 
     def __init__(self, expr: mir.RelationExpr, name: str = "df",
                  state_cap: int = 256, out_levels: int = 2,
-                 out_slots: int | None = None):
+                 out_slots: int | None = None,
+                 force_merge_ingest: bool = False):
         from ..expr import strings
 
         self.expr = expr
@@ -2229,7 +2250,18 @@ class Dataflow(_DataflowBase):
 
         self._fingerprint = expr_fingerprint(expr)
         self._str_keys, self._str_depth = strings.collect_keys(expr)
-        ctx = _RenderContext({}, state_cap=state_cap)
+        # Tier quantization (ISSUE 16): a requested state_cap snaps to
+        # its pow2 menu rung so two DDLs differing only in size render
+        # byte-identical programs and share one program-bank key.
+        from ..plan.decisions import quantize_cap
+
+        state_cap = quantize_cap(state_cap)
+        ctx = _RenderContext(
+            {}, state_cap=state_cap,
+            force_merge_ingest=force_merge_ingest,
+        )
+        if force_merge_ingest:
+            out_slots = 0
         if out_slots is None:
             # Ingest-mode decision for the output index (plan layer —
             # same source of truth EXPLAIN prints): append-slot ring
@@ -2479,11 +2511,15 @@ class ShardedDataflow(_DataflowBase):
         self.axis_name = mesh.axis_names[0]
         self.num_shards = int(mesh.shape[self.axis_name])
         self.out_schema = expr.schema()
-        self.input_shard_cap = input_shard_cap
+        # Quantize every requested capacity to the pow2 menu
+        # (ISSUE 16): size-only differences must share bank keys.
+        from ..plan.decisions import quantize_cap
+
+        self.input_shard_cap = quantize_cap(input_shard_cap)
         self._sharding = worker_sharding(mesh, self.axis_name)
-        self._slot_cap0 = slot_cap
-        self._output_cap = output_cap
-        self._state_cap = state_cap
+        self._slot_cap0 = quantize_cap(slot_cap)
+        self._output_cap = quantize_cap(output_cap)
+        self._state_cap = quantize_cap(state_cap)
         self._out_levels = out_levels
         self._requested_out_slots = out_slots
         self._shard_prop_report: dict | None = None

@@ -34,12 +34,23 @@ def _free_port() -> int:
     return port
 
 
+def bank_path(data_dir: str) -> str:
+    """The deployment's program-bank directory (ISSUE 16): under the
+    blob root, so the bank rides the same durable storage the shards
+    do and ``--recover`` finds warm executables next to warm state."""
+    return os.path.join(data_dir, "blob", "program_bank")
+
+
 def spawn_replica(
     data_dir: str, port: int, rid: str, workers: int = 1
 ) -> subprocess.Popen:
     """One clusterd subprocess (orchestrator-process analog)."""
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", ""))
+    # Subprocess replicas share the deployment's program bank: the env
+    # var is resolved once by compile.bank.get_bank() at first
+    # ledger_jit dispatch — no flag threading through replica main.
+    env.setdefault("MZ_PROGRAM_BANK", bank_path(data_dir))
     return subprocess.Popen(
         [
             sys.executable, "-m", "materialize_tpu.coord.replica",
@@ -68,6 +79,13 @@ class Environment:
     ):
         os.makedirs(data_dir, exist_ok=True)
         self.data_dir = data_dir
+        # Every process in the deployment — this one (coordinator +
+        # in-process replicas) and spawned subprocess replicas (via
+        # MZ_PROGRAM_BANK in spawn_replica) — shares one bank under
+        # the blob root. Recovery's re-renders become bank hits.
+        from ..compile.bank import configure_bank
+
+        configure_bank(bank_path(data_dir))
         self.procs: list[subprocess.Popen] = []
         self._threads = []
         replica_ports = []
@@ -123,6 +141,25 @@ class Environment:
         (the programmatic face of `mz_recovery`)."""
         report = {"coordinator": dict(self.coord.recovery)}
         report.update(self.coord.controller.recovery_snapshot())
+        # Compile breakdown (ISSUE 16): how much of this boot's
+        # compile wall the program bank absorbed. A warm-bank recover
+        # of unchanged fingerprints shows bank_misses == 0 — ZERO
+        # fresh XLA compiles — with the skipped wall in
+        # compile_seconds_recovered.
+        from ..compile.bank import get_bank
+        from ..utils.compile_ledger import LEDGER
+
+        s = LEDGER.summary()
+        compiles = {
+            "bank_hits": s["bank_hits"],
+            "bank_misses": s["bank_misses"],
+            "compile_seconds_recovered": s["bank_seconds_recovered"],
+            "fresh_compiles": s["misses"],
+        }
+        bank = get_bank()
+        if bank is not None:
+            compiles["bank"] = bank.snapshot()
+        report["compiles"] = compiles
         return report
 
     def await_recovery(self, timeout: float = 120.0) -> dict:
@@ -139,6 +176,27 @@ class Environment:
             self.coord.controller.wait_installed(
                 name, timeout=max(deadline - _t.monotonic(), 0.1)
             )
+        # Install-acked is not compile-counted: hydration is the phase
+        # that consults the program bank, and subprocess replicas ship
+        # their compile records on the same Frontiers report that
+        # flips the hydration board. Wait for the readiness verdict
+        # (every durable dataflow hydrated somewhere), then let the
+        # piggybacked ledger settle, so the report's `compiles` block
+        # describes this boot instead of racing it.
+        while _t.monotonic() < deadline:
+            if self.coord.health()["ready"]:
+                break
+            _t.sleep(0.05)
+        from ..utils.compile_ledger import LEDGER
+
+        settle_until = min(deadline, _t.monotonic() + 5.0)
+        prev = LEDGER.summary()
+        while _t.monotonic() < settle_until:
+            _t.sleep(0.1)
+            cur = LEDGER.summary()
+            if cur == prev:
+                break
+            prev = cur
         return self.recovery_report()
 
     def shutdown(self) -> dict:
@@ -151,6 +209,13 @@ class Environment:
         if self._down:
             return report
         self._down = True
+        # Un-configure the process-global bank: the deployment owns
+        # its bank directory; a later Environment (or a bankless
+        # caller in the same process, e.g. the test suite) must not
+        # keep writing into this one.
+        from ..compile.bank import configure_bank
+
+        configure_bank(None)
         self.pg.stop()
         self.http.stop()
         self.coord.shutdown()

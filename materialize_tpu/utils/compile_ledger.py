@@ -32,6 +32,16 @@ in-process replicas (which share this ledger) never double-report.
 Surfaces: the ``mz_compile_log`` introspection relation, the
 ``mz_compile_*`` /metrics families, EXPLAIN ANALYSIS's ``compiles:``
 block, and ``bench.py --trace``'s ``compiles`` summary.
+
+With a program bank configured (ISSUE 16, compile/bank.py) every
+``ledger_jit`` site becomes a bank lookup point. First sight of a
+``(kind, fingerprint, tier)`` in this process consults the bank: a
+usable entry deserializes in milliseconds and records ``bank_hit``
+(attrs carry the compile seconds the hit recovered); a bank miss
+compiles AHEAD-OF-TIME (``fn.lower(...).compile()`` — one trace, one
+compile, and the executable in hand) and writes the entry back. The
+resolved executable is routed directly on subsequent calls. Bank-off
+dispatch is byte-identical to the pre-bank hot path.
 """
 
 from __future__ import annotations
@@ -51,7 +61,11 @@ class CompileRecord:
     fingerprint: str  # stable identity of the rendered program family
     tier: str  # tier vector: capacity/shape signature of this compile
     seconds: float
-    cache: str  # "miss" (first sight) | "hit" (recompiled a known key)
+    # "miss" (first sight, compiled) | "hit" (recompiled a known key)
+    # | "bank_hit" (served from the program bank — no XLA compile;
+    # seconds is the deserialize wall, attrs["recovered_seconds"] the
+    # compile wall it skipped)
+    cache: str
     when: float = 0.0  # wall-clock stamp
     pid: int = 0
     process: str = ""
@@ -113,6 +127,16 @@ class CompileLedger:
                         300,
                     ),
                 ),
+                REGISTRY.get_or_create(
+                    "counter", "mz_compile_bank_hits_total",
+                    "programs served from the persistent AOT bank "
+                    "(deserialized, no XLA compile)",
+                ),
+                REGISTRY.get_or_create(
+                    "counter", "mz_compile_bank_misses_total",
+                    "compiles whose key was absent from the bank "
+                    "(entry written back after the compile)",
+                ),
             )
         return self._metrics
 
@@ -124,11 +148,23 @@ class CompileLedger:
         fingerprint: str,
         tier: str,
         seconds: float,
+        cache: str | None = None,
         **attrs,
     ) -> CompileRecord:
         key = (kind, fingerprint, tier)
         with self._lock:
-            cache = "hit" if key in self._seen else "miss"
+            if cache is None:
+                if key in self._seen:
+                    cache = "hit"
+                else:
+                    # Bounded-_seen misclassification fix (ISSUE 16
+                    # satellite): an evicted key's recompile used to
+                    # re-classify as "miss" — harmless while hit/miss
+                    # was pure measurement, wrong once the bank serves
+                    # the key. The bank's on-disk entry is the durable
+                    # _seen: if it holds the key, this compile is a
+                    # re-compile of a known program, never a cold miss.
+                    cache = "hit" if self._bank_has(key) else "miss"
             self._seen[key] = True
             while len(self._seen) > self.SEEN_CAP:
                 self._seen.pop(next(iter(self._seen)))
@@ -140,11 +176,30 @@ class CompileLedger:
             self._buf.append(rec)
             if self._ship is not None:
                 self._ship.append(rec)
-        total, misses, hits, hist = self._metric_handles()
-        total.inc()
-        (misses if cache == "miss" else hits).inc()
-        hist.observe(seconds)
+        handles = self._metric_handles()
+        total, misses, hits, hist = handles[:4]
+        bank_hits, bank_misses = handles[4:]
+        if cache == "bank_hit":
+            bank_hits.inc()
+        else:
+            total.inc()
+            (misses if cache == "miss" else hits).inc()
+            hist.observe(seconds)
+            if attrs.get("bank") == "miss":
+                bank_misses.inc()
         return rec
+
+    @staticmethod
+    def _bank_has(key: tuple) -> bool:
+        """Durable seen-check against the program bank; never raises
+        (called under the ledger lock on the compile path)."""
+        try:
+            from ..compile import bank as _bank
+
+            b = _bank.get_bank()
+            return b is not None and b.has(*key)
+        except Exception:
+            return False
 
     # -- cross-process shipping (Frontiers piggyback) ------------------------
     def enable_ship(self, capacity: int = 4096) -> None:
@@ -178,10 +233,16 @@ class CompileLedger:
 
     def summary(self, names: set | None = None) -> dict:
         """Totals (optionally scoped to dataflow ``names``): the
-        EXPLAIN ANALYSIS / bench.py surface."""
+        EXPLAIN ANALYSIS / bench.py surface. ``bank_hit`` records are
+        NOT compiles — they count separately (``bank_hits``,
+        ``bank_seconds_recovered`` = the compile wall they skipped),
+        so ``compiles``/``misses``/``hits`` keep their pre-bank
+        meaning."""
         recs = self.records()
         if names is not None:
             recs = [r for r in recs if r.name in names]
+        banked = [r for r in recs if r.cache == "bank_hit"]
+        recs = [r for r in recs if r.cache != "bank_hit"]
         out = {
             "compiles": len(recs),
             "misses": sum(1 for r in recs if r.cache == "miss"),
@@ -189,6 +250,17 @@ class CompileLedger:
             "seconds": round(sum(r.seconds for r in recs), 3),
             "hit_seconds": round(
                 sum(r.seconds for r in recs if r.cache == "hit"), 3
+            ),
+            "bank_hits": len(banked),
+            "bank_misses": sum(
+                1 for r in recs if r.attrs.get("bank") == "miss"
+            ),
+            "bank_seconds_recovered": round(
+                sum(
+                    float(r.attrs.get("recovered_seconds", 0.0))
+                    for r in banked
+                ),
+                3,
             ),
             "by_kind": {},
         }
@@ -249,11 +321,17 @@ def tier_vector(args: tuple) -> str:
 
 
 class LedgeredJit:
-    """A ``jax.jit`` wrapper that records actual compiles. The hot
-    path costs two C attribute reads and a perf_counter call; ledger
-    work happens only on the (seconds-long) compile itself."""
+    """A ``jax.jit`` wrapper that records actual compiles. With no
+    bank configured (the default) the hot path costs two C attribute
+    reads and a perf_counter call; ledger work happens only on the
+    (seconds-long) compile itself. With a bank, dispatch routes
+    through per-tier resolved executables (one dict probe + a
+    tier_vector digest — microseconds against the ~ms device step),
+    and first sight of a tier goes bank-lookup-then-AOT-compile."""
 
-    __slots__ = ("fn", "kind", "name", "fingerprint", "ledger")
+    __slots__ = (
+        "fn", "kind", "name", "fingerprint", "ledger", "_routes",
+    )
 
     def __init__(self, fn, kind, name, fingerprint, ledger=None):
         self.fn = fn
@@ -261,8 +339,17 @@ class LedgeredJit:
         self.name = name
         self.fingerprint = fingerprint
         self.ledger = ledger if ledger is not None else LEDGER
+        self._routes = {}
 
     def __call__(self, *args, **kwargs):
+        from ..compile import bank as _bank
+
+        b = _bank.BANK if _bank._resolved else _bank.get_bank()
+        if b is not None:
+            return self._banked_call(b, args, kwargs)
+        return self._plain_call(args, kwargs)
+
+    def _plain_call(self, args, kwargs):
         fn = self.fn
         try:
             n0 = fn._cache_size()
@@ -279,6 +366,58 @@ class LedgeredJit:
                 _time.perf_counter() - t0,
             )
         return out
+
+    # -- program-bank dispatch (ISSUE 16) ---------------------------------
+    def _banked_call(self, b, args, kwargs):
+        tier = tier_vector(args)
+        route = self._routes.get(tier)
+        if route is None:
+            route = self._resolve_route(b, tier, args, kwargs)
+            self._routes[tier] = route
+        if route is False:
+            # Unbankable program (serializer/lowering limits): the
+            # plain jit path, with normal ledger accounting.
+            return self._plain_call(args, kwargs)
+        try:
+            return route(*args, **kwargs)
+        except Exception:
+            # A resolved executable the runtime won't accept (layout
+            # or structure drift) must degrade to a recompile, never
+            # to an error or a wrong result.
+            self._routes[tier] = False
+            return self._plain_call(args, kwargs)
+
+    def _resolve_route(self, b, tier, args, kwargs):
+        key = (self.kind, self.fingerprint, tier)
+        t0 = _time.perf_counter()
+        loaded = b.lookup(*key)
+        if loaded is not None:
+            compiled, meta = loaded
+            self.ledger.record(
+                self.kind, self.name, self.fingerprint, tier,
+                _time.perf_counter() - t0,
+                cache="bank_hit",
+                recovered_seconds=float(meta.get("seconds", 0.0)),
+            )
+            return compiled
+        # Bank miss: compile ahead-of-time so the executable is in
+        # hand for both dispatch and the write-back (calling the jit
+        # would compile internally and keep the Compiled out of
+        # reach).
+        try:
+            compiled = self.fn.lower(*args, **kwargs).compile()
+        except Exception:
+            return False
+        secs = _time.perf_counter() - t0
+        self.ledger.record(
+            self.kind, self.name, self.fingerprint, tier, secs,
+            bank="miss",
+        )
+        b.store(
+            self.kind, self.fingerprint, tier, compiled,
+            seconds=secs, name=self.name,
+        )
+        return compiled
 
     def lower(self, *args, **kwargs):
         return self.fn.lower(*args, **kwargs)

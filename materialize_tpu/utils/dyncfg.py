@@ -246,6 +246,31 @@ SPAN_DONATION = Config(
     "read back",
 ).register(COMPUTE_CONFIGS)
 
+# -- the persistent AOT program bank (ISSUE 16) ------------------------------
+
+PROGRAM_BANK_PATH = Config(
+    "program_bank_path", "",
+    "directory of the persistent cross-process AOT program bank "
+    "(compile/bank.py): every ledger_jit site looks serialized "
+    "executables up by (kind, fingerprint, tier) before compiling "
+    "and writes misses back. Empty = bank off (dispatch is "
+    "byte-identical to the pre-bank hot path). environmentd sets "
+    "this to <data-dir>/blob/program_bank; SET propagates it to "
+    "replicas like every dyncfg",
+).register(COMPUTE_CONFIGS)
+
+ENABLE_ASYNC_COMPILE = Config(
+    "enable_async_compile", False,
+    "async DDL compile + hot-swap (requires a program bank): CREATE "
+    "INDEX / CREATE MATERIALIZED VIEW installs its dataflow in "
+    "generic merge mode immediately (correct results, O(run0) "
+    "ingest) while a background worker pre-compiles the specialized "
+    "program into the bank; the replica hot-swaps at a span boundary "
+    "(sync_spans sequencing — no half-applied carry). Surfaced in "
+    "EXPLAIN ANALYSIS compiles: pending_swap, the hydration board, "
+    "and mz_program_bank",
+).register(COMPUTE_CONFIGS)
+
 # -- buffer-provenance / donation safety (ISSUE 8) ---------------------------
 
 BUFFER_SANITIZER = Config(

@@ -308,7 +308,256 @@ def run_bench_mode(verbose: bool) -> int:
     rc |= run_subscribe_smoke(gate, budgets)
     rc |= run_trace_overhead_gate(gate)
     rc |= run_mz_relations_gate(gate)
+    rc |= run_bank_roundtrip_gate(gate)
+    rc |= run_tier_quantization_gate(gate)
     return rc
+
+
+# One deterministic churn workload, shared by the program-bank gates:
+# duplicate/retraction churn over a bare-Get index, net rows compared
+# across processes (the same content-equivalence discipline as
+# tests/oracle.net_rows).
+_BANK_GATE_SCRIPT = r"""
+import json, sys
+import numpy as np
+from materialize_tpu.compile.bank import configure_bank, get_bank
+from materialize_tpu.expr import relation as mir
+from materialize_tpu.render.dataflow import Dataflow
+from materialize_tpu.repr.batch import Batch
+from materialize_tpu.repr.schema import Column, ColumnType, Schema
+from materialize_tpu.utils.compile_ledger import LEDGER
+
+configure_bank(sys.argv[1])
+sch = Schema(
+    (Column("k", ColumnType.INT64), Column("v", ColumnType.INT64))
+)
+df = Dataflow(mir.Get("src", sch), name="bank-smoke")
+rng = np.random.default_rng(7)
+t0 = df.time
+for i in range(6):
+    n = 32
+    k = rng.integers(0, 64, n).astype(np.int64)
+    v = rng.integers(0, 8, n).astype(np.int64)
+    d = rng.choice(np.asarray([1, 1, -1]), n).astype(np.int64)
+    df.run_steps([{"src": Batch.from_numpy(
+        sch, [k, v], np.uint64(t0 + i), d, capacity=64
+    )}])
+df._compact_now()
+assert not df.check_flags(), "overflow in bank gate workload"
+from collections import defaultdict
+acc = defaultdict(int)
+for r in df.peek():
+    acc[tuple(int(c) for c in r[:-2])] += int(r[-1])
+rows = sorted([*k, n] for k, n in acc.items() if n != 0)
+s = LEDGER.summary()
+print(json.dumps({
+    "rows": rows,
+    "bank_hits": s["bank_hits"],
+    "bank_misses": s["bank_misses"],
+    "fresh_compiles": s["misses"],
+    "caches": sorted({r.cache for r in LEDGER.records()}),
+    "bank": get_bank().snapshot(),
+}))
+"""
+
+
+def _run_bank_script(bank_dir: str, xla_cache_dir: str):
+    import json
+    import subprocess
+    import sys
+
+    # A cold, gate-private XLA persistent cache: executables
+    # rehydrated from a warm host cache cannot be re-serialized (the
+    # payload fails deserialization), so a warm host cache would make
+    # the cold run's stores fail verification and the gate flake.
+    env = dict(os.environ)
+    env["MATERIALIZE_TPU_COMPILE_CACHE"] = xla_cache_dir
+    out = subprocess.run(
+        [sys.executable, "-c", _BANK_GATE_SCRIPT, bank_dir],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    if out.returncode != 0:
+        tail = out.stderr.strip().splitlines()
+        raise RuntimeError(
+            f"bank gate subprocess rc={out.returncode}: "
+            + (tail[-1] if tail else "no stderr")
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def run_bank_roundtrip_gate(gate) -> int:
+    """Program-bank round-trip gate (ISSUE 16 satellite): run the
+    same deterministic churn workload in TWO fresh subprocesses
+    sharing one bank directory. The first (cold) run compiles and
+    exports every program; the second runs with EVERY in-process
+    cache gone (new interpreter) and must (a) produce byte-identical
+    net rows, (b) record bank_hit serves, and (c) pay ZERO fresh
+    XLA compiles — the restart-proof invariant, checked in CI on CPU
+    before any hardware run."""
+    import shutil
+    import tempfile
+
+    from materialize_tpu.analysis import LintFinding
+
+    findings = []
+    bank_dir = tempfile.mkdtemp(prefix="bank-gate-")
+    xla_cache = tempfile.mkdtemp(prefix="bank-gate-xla-")
+    try:
+        cold = _run_bank_script(bank_dir, xla_cache)
+        warm = _run_bank_script(bank_dir, xla_cache)
+        if cold["bank"]["stores"] == 0:
+            findings.append(LintFinding(
+                "bank-roundtrip", "export",
+                "cold run stored no bank entries: ledger_jit sites "
+                "no longer write back to the program bank",
+            ))
+        if warm["rows"] != cold["rows"]:
+            findings.append(LintFinding(
+                "bank-roundtrip", "equivalence",
+                "bank-served run produced different net rows than "
+                f"the fresh-compile run: {warm['rows'][:5]!r} vs "
+                f"{cold['rows'][:5]!r}",
+            ))
+        if warm["bank_hits"] == 0 or "bank_hit" not in warm["caches"]:
+            findings.append(LintFinding(
+                "bank-roundtrip", "reimport",
+                "warm run recorded no bank_hit: the bank lookup path "
+                f"never served (caches={warm['caches']!r})",
+            ))
+        if warm["fresh_compiles"] != 0:
+            findings.append(LintFinding(
+                "bank-roundtrip", "compile-wall",
+                f"warm run still paid {warm['fresh_compiles']} fresh "
+                "XLA compile(s) with every fingerprint banked — the "
+                "restart proof requires ZERO",
+            ))
+    except OSError as e:
+        print(f"bank-roundtrip: skipped (environment: {e!r})")
+        return 0
+    except Exception as e:
+        findings = [LintFinding(
+            "bank-roundtrip", "driver",
+            f"bank roundtrip gate failed to run: {e!r}",
+        )]
+    finally:
+        shutil.rmtree(bank_dir, ignore_errors=True)
+        shutil.rmtree(xla_cache, ignore_errors=True)
+    gate("bank-roundtrip", None, findings, 0)
+    return 1 if findings else 0
+
+
+def run_tier_quantization_gate(gate) -> int:
+    """Tier-quantization gate (ISSUE 16 satellite): two DDLs whose
+    requested capacities differ only WITHIN one pow2 rung (state_cap
+    300 vs 400, both snapping to 512) must share every bank key — the
+    second dataflow adds ZERO new bank entries and serves its step
+    programs as bank hits. A capacity leaking un-quantized into tier
+    vectors (or a menu regression) fails here."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from materialize_tpu.analysis import LintFinding
+    from materialize_tpu.compile.bank import configure_bank, get_bank
+    from materialize_tpu.expr import relation as mir
+    from materialize_tpu.plan.decisions import quantize_cap
+    from materialize_tpu.render.dataflow import Dataflow
+    from materialize_tpu.repr.batch import Batch
+    from materialize_tpu.repr.schema import Column, ColumnType, Schema
+
+    findings = []
+    bank_dir = tempfile.mkdtemp(prefix="quant-gate-")
+    # Cold, gate-private XLA persistent cache for the in-process
+    # compiles: executables rehydrated from a warm host cache cannot
+    # be re-serialized, so their stores would fail verification and
+    # the key-sharing check would flake (see run_bank_roundtrip_gate).
+    import jax
+
+    xla_cache = tempfile.mkdtemp(prefix="quant-gate-xla-")
+    old_cache = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", xla_cache)
+    try:
+        if quantize_cap(300) != quantize_cap(400):
+            findings.append(LintFinding(
+                "tier-quantization", "menu",
+                f"300 and 400 landed on different rungs "
+                f"({quantize_cap(300)} vs {quantize_cap(400)}): the "
+                "pow2 menu no longer coalesces size-only DDL "
+                "differences",
+            ))
+        configure_bank(bank_dir)
+        sch = Schema((Column("k", ColumnType.INT64),
+                      Column("v", ColumnType.INT64)))
+
+        def run_once(cap: int):
+            rng = np.random.default_rng(11)
+            df = Dataflow(
+                mir.Get("src", sch), name=f"quant-{cap}",
+                state_cap=cap,
+            )
+            t0 = df.time
+            for i in range(3):
+                n = 16
+                k = rng.integers(0, 32, n).astype(np.int64)
+                v = rng.integers(0, 8, n).astype(np.int64)
+                d = rng.choice(
+                    np.asarray([1, 1, -1]), n
+                ).astype(np.int64)
+                df.run_steps([{"src": Batch.from_numpy(
+                    sch, [k, v], np.uint64(t0 + i), d, capacity=64
+                )}])
+            from collections import defaultdict
+
+            acc: dict = defaultdict(int)
+            for r in df.peek():
+                acc[tuple(int(c) for c in r[:-2])] += int(r[-1])
+            return sorted(
+                (*key, n) for key, n in acc.items() if n != 0
+            )
+        try:
+            rows_a = run_once(300)
+            entries_after_a = get_bank().snapshot()["entries"]
+            hits_before = get_bank().stats["hits"]
+            rows_b = run_once(400)
+            snap = get_bank().snapshot()
+        finally:
+            configure_bank(None)
+        if rows_a != rows_b:
+            findings.append(LintFinding(
+                "tier-quantization", "equivalence",
+                "same churn through the two rung-mates produced "
+                "different net rows",
+            ))
+        if snap["entries"] != entries_after_a:
+            findings.append(LintFinding(
+                "tier-quantization", "key-sharing",
+                f"the second DDL grew the bank from "
+                f"{entries_after_a} to {snap['entries']} entries: "
+                "capacities within one pow2 rung no longer share "
+                "bank keys",
+            ))
+        if snap["hits"] == hits_before:
+            findings.append(LintFinding(
+                "tier-quantization", "reuse",
+                "the second DDL served no bank hits despite "
+                "rung-identical capacities",
+            ))
+    except OSError as e:
+        print(f"tier-quantization: skipped (environment: {e!r})")
+        return 0
+    except Exception as e:
+        findings = [LintFinding(
+            "tier-quantization", "driver",
+            f"tier quantization gate failed to run: {e!r}",
+        )]
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_cache)
+        shutil.rmtree(bank_dir, ignore_errors=True)
+        shutil.rmtree(xla_cache, ignore_errors=True)
+    gate("tier-quantization", None, findings, 0)
+    return 1 if findings else 0
 
 
 def run_trace_overhead_gate(gate) -> int:

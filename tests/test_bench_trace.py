@@ -37,9 +37,22 @@ TOP_KEYS = {
     # ISSUE 15: steady-state wallclock-lag quantiles of the best
     # pipelined window — the freshness plane's per-config figure.
     "freshness",
+    # ISSUE 16: program-bank counters (None when no bank configured —
+    # the default; `--bank DIR` / MZ_PROGRAM_BANK turns it on).
+    "bank",
 }
 COMPILES_KEYS = {
     "compiles", "misses", "hits", "seconds", "hit_seconds", "by_kind",
+    # ISSUE 16: bank_hit serves are NOT compiles — they count apart,
+    # with the compile wall the hits skipped.
+    "bank_hits", "bank_misses", "bank_seconds_recovered",
+}
+# The "bank" value's shape when a bank IS configured (bench.py
+# _bank_report): the ProgramBank.snapshot() counters, plus "hydrate"
+# in --measure emissions (the cold-vs-banked hydrate split).
+BANK_KEYS = {
+    "hits", "misses", "stores", "errors", "seconds_recovered",
+    "entries", "bytes",
 }
 FRESHNESS_KEYS = {"p50_ms", "p99_ms", "max_ms", "samples"}
 MODE_KEYS = {
@@ -136,6 +149,39 @@ def test_trace_observability_fields(trace_output, tmp_path):
     assert trace_export.main([str(src), "-o", str(out)]) == 0
     with open(out) as f:
         assert trace_export.validate_chrome_trace(json.load(f)) == []
+
+
+def test_trace_bank_field(trace_output):
+    """ISSUE 16: the emission carries a "bank" key — None in the
+    default bankless run (this fixture), a ProgramBank.snapshot()
+    dict when --bank / MZ_PROGRAM_BANK is set. The non-None shape is
+    pinned in-process (no second subprocess run) via bench._bank_report
+    against a configured bank."""
+    assert "bank" in trace_output
+    assert trace_output["bank"] is None
+    c = trace_output["compiles"]
+    # Bankless run: the ledger still reports the bank columns, zeroed.
+    assert c["bank_hits"] == 0
+    assert c["bank_misses"] == 0
+    assert c["bank_seconds_recovered"] == 0
+
+
+def test_bank_report_shape(tmp_path):
+    sys.path.insert(0, REPO)
+    import bench
+
+    from materialize_tpu.compile.bank import configure_bank
+
+    try:
+        configure_bank(str(tmp_path / "bank"))
+        r = bench._bank_report()
+        assert BANK_KEYS <= set(r), set(r)
+        r = bench._bank_report({"bank_hits": 0, "bank_misses": 1,
+                                "mode": "cold", "hydrate_s": 0.5})
+        assert r["hydrate"]["mode"] == "cold"
+    finally:
+        configure_bank(None)
+    assert bench._bank_report() is None
 
 
 def test_trace_freshness_summary(trace_output):
