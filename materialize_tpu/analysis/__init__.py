@@ -37,6 +37,14 @@ Three passes:
   census (the comm analog of ``op_census``) and the SPMD-safety
   verdict gating per-device slot-ring ingest under ``shard_map``
   (ISSUE 9).
+- ``racecheck`` / ``interleave``: the concurrency pair (ISSUE 17) —
+  a vector-clock happens-before race detector over the control
+  plane's declared shared state (dyncfg ``race_detector``), and a
+  DPOR interleaving explorer that model-checks the coordination
+  protocols (fencing, reconciliation, the SET crash window, peek
+  batching, subscribe teardown) exhaustively. ``racecheck`` is
+  re-exported here; ``interleave`` is imported directly (its model
+  factories lazily import coord modules).
 
 See doc/analysis.md for the catalogue of invariants and lints.
 """
@@ -109,6 +117,8 @@ from .typecheck import (  # noqa: F401
     typecheck,
     typecheck_lir,
 )
+from . import racecheck  # noqa: F401
+from .racecheck import RaceFinding  # noqa: F401
 
 
 def report(expr, source_monotonic=frozenset()) -> str:

@@ -68,7 +68,12 @@ class ProgramBank:
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
-        self._lock = threading.Lock()
+        # Tracked (ISSUE 17): the bank store lock is taken from every
+        # jit site AND the async compile worker — it must stay a leaf
+        # in the observed lock-order graph.
+        from ..utils.lockcheck import tracked_lock
+
+        self._lock = tracked_lock("compile.bank")
         self._stamp: dict | None = None
         # Counters for mz_program_bank / the recovery report.
         self.stats = {

@@ -51,7 +51,12 @@ class CompileWorker:
     def __init__(self):
         self._q: queue.Queue = queue.Queue()
         self._thread: threading.Thread | None = None
-        self._lock = threading.Lock()
+        # Tracked (ISSUE 17): guards the task table shared between
+        # replica worker loops (submit/poll at span boundaries) and
+        # the compile thread.
+        from ..utils.lockcheck import tracked_lock
+
+        self._lock = tracked_lock("compile.worker")
         self.tasks: dict[str, CompileTask] = {}
 
     def submit(self, desc) -> CompileTask:

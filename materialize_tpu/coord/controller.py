@@ -21,6 +21,7 @@ import time as _time
 from collections import deque
 
 from . import protocol as ctp
+from ..utils import lockcheck as _lockcheck
 from ..utils import retry as retry_mod
 from .peek import PeekTimedOut, ServerBusy
 from .protocol import DataflowDescription
@@ -304,7 +305,13 @@ class PeekBatcher:
         ctrl = self.ctrl
         peek_id = next(ctrl._peek_counter)
         ev = threading.Event()
-        ctrl._peek_events[peek_id] = ev
+        # Registered under the controller lock: the absorber reads
+        # this map on every PeekResponse, and an unlocked insert from
+        # the flusher thread was a detector-confirmed race
+        # (tests/test_racecheck.py pins it).
+        with ctrl._lock:
+            _lockcheck.shared_write("controller.peek_events")
+            ctrl._peek_events[peek_id] = ev
         spec = {
             "scan": bool(scan),
             "bound_cols": tuple(bound_cols),
@@ -346,6 +353,7 @@ class PeekBatcher:
                     error = resp["error"]
         finally:
             with ctrl._lock:
+                _lockcheck.shared_write("controller.peek_events")
                 ctrl._peek_events.pop(batch.peek_id, None)
                 ctrl._peek_results.pop(batch.peek_id, None)
             ctrl._broadcast(ctp.cancel_peek(batch.peek_id))
@@ -417,10 +425,29 @@ class ReplicaClient:
         self._cmd_q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self.connected = threading.Event()
+        # Session/fence counters are written by the connection thread
+        # and read by recovery_snapshot / mz_recovery from session
+        # threads — a plain int increment is atomic under the GIL but
+        # invisible to the happens-before order, so the race detector
+        # (rightly) flagged the pair. Guarded by a dedicated leaf lock;
+        # read through stats().
+        self._stats_lock = _lockcheck.tracked_lock(
+            "controller.replica_stats"
+        )
         self.sessions = 0  # established sessions (reconnects = n-1)
         self.fenced = 0  # HelloRejects observed (newer epoch exists)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            _lockcheck.shared_read("controller.replica_stats")
+            return {
+                "sessions": self.sessions,
+                "reconnects": max(self.sessions - 1, 0),
+                "fenced": self.fenced,
+                "connected": self.connected.is_set(),
+            }
 
     def send(self, cmd: dict) -> None:
         self._cmd_q.put(cmd)
@@ -463,14 +490,21 @@ class ReplicaClient:
                     # Fast-forward past the fencing epoch: the next
                     # attempt must win immediately, not probe one
                     # nonce per backoff cycle (recovery time).
-                    self.fenced += 1
+                    with self._stats_lock:
+                        _lockcheck.shared_write(
+                            "controller.replica_stats"
+                        )
+                        self.fenced += 1
                     retry_mod.fenced_epochs_total().inc()
                     self._nonce_counter.bump_past(
                         int(resp.get("epoch", 0))
                     )
                 raise ctp.TransportError(f"hello rejected: {resp}")
-            self.sessions += 1
-            if self.sessions > 1:
+            with self._stats_lock:
+                _lockcheck.shared_write("controller.replica_stats")
+                self.sessions += 1
+                reconnect = self.sessions > 1
+            if reconnect:
                 retry_mod.reconnects_total().inc()
             # Rehydration: replay the compacted history. The replica
             # reconciles (keeps unchanged dataflows) and drops the rest.
@@ -618,20 +652,29 @@ class ComputeController:
     def add_replica(self, name: str, addr: tuple[str, int]) -> None:
         """Provision a replica (cluster-controller ensure_service analog);
         it will connect, receive the history, and hydrate."""
-        self.replicas[name] = ReplicaClient(
+        rc = ReplicaClient(
             name, addr, self._history_snapshot, self.responses,
             self._nonce_counter,
         )
+        # The replicas map is iterated by _broadcast (any session
+        # thread) and checked by the absorber mid-Frontiers-ingest;
+        # mutating it outside _lock was a detector-confirmed race
+        # (tests/test_racecheck.py pins it).
         with self._lock:
+            _lockcheck.shared_write("controller.replicas")
+            self.replicas[name] = rc
             dataflows = list(self._dataflows)
         for df in dataflows:
             self.hydration.seed((df, name))
 
     def drop_replica(self, name: str) -> None:
-        rc = self.replicas.pop(name, None)
+        with self._lock:
+            _lockcheck.shared_write("controller.replicas")
+            rc = self.replicas.pop(name, None)
         if rc is not None:
             rc.stop()
         with self._lock:
+            _lockcheck.shared_write("controller.observed")
             for per_df in self.frontiers.values():
                 per_df.pop(name, None)
             for per_df in self.arrangement_records.values():
@@ -658,7 +701,14 @@ class ComputeController:
             return history, set(self._dataflows)
 
     def _broadcast(self, cmd: dict) -> None:
-        for rc in self.replicas.values():
+        # Snapshot under _lock (iterating the live dict races
+        # add/drop_replica); sends happen outside — rc.send is just a
+        # queue put, but a slow replica must not serialize the others
+        # behind the controller lock.
+        with self._lock:
+            _lockcheck.shared_read("controller.replicas")
+            targets = list(self.replicas.values())
+        for rc in targets:
             rc.send(cmd)
 
     # -- commands -------------------------------------------------------------
@@ -699,6 +749,7 @@ class ComputeController:
             # replica gets the dataflow from history replay later, and
             # must not stall DDL (chaos kills replicas mid-run).
             with self._lock:
+                _lockcheck.shared_read("controller.replicas")
                 connected = [
                     r
                     for r, rc in self.replicas.items()
@@ -749,6 +800,7 @@ class ComputeController:
 
     def drop_dataflow(self, name: str) -> None:
         with self._lock:
+            _lockcheck.shared_write("controller.observed")
             self._dataflows.pop(name, None)
             self.frontiers.pop(name, None)
             self.arrangement_records.pop(name, None)
@@ -783,7 +835,12 @@ class ComputeController:
 
         peek_id = next(self._peek_counter)
         ev = threading.Event()
-        self._peek_events[peek_id] = ev
+        # Same discipline as the batcher's _dispatch_group: the
+        # absorber walks this map under _lock, so the insert must be
+        # under it too.
+        with self._lock:
+            _lockcheck.shared_write("controller.peek_events")
+            self._peek_events[peek_id] = ev
         with TRACER.span(
             "controller.peek", dataflow=dataflow, peek_id=peek_id
         ):
@@ -814,6 +871,7 @@ class ComputeController:
                 # the absorber's lock: later duplicate responses cannot
                 # leak.
                 with self._lock:
+                    _lockcheck.shared_write("controller.peek_events")
                     self._peek_events.pop(peek_id, None)
                     self._peek_results.pop(peek_id, None)
                 self._broadcast(ctp.cancel_peek(peek_id))
@@ -858,7 +916,10 @@ class ComputeController:
                 with self._lock:
                     # A dropped replica may still have queued reports:
                     # discard them or they pin the definite frontier.
-                    if replica in self.replicas:
+                    _lockcheck.shared_read("controller.replicas")
+                    known = replica in self.replicas
+                    if known:
+                        _lockcheck.shared_write("controller.observed")
                         for df, upper in msg["uppers"].items():
                             self.frontiers.setdefault(df, {})[
                                 replica
@@ -902,8 +963,11 @@ class ComputeController:
                 # Trace spans and compile records merge into the
                 # process-global rings OUTSIDE the controller lock
                 # (ingest has its own; pid-dedupe makes in-process
-                # replicas — which share the rings — a no-op).
-                if replica in self.replicas:
+                # replicas — which share the rings — a no-op). The
+                # membership verdict is the one taken under _lock
+                # above — re-reading the live dict here unlocked was a
+                # detector finding.
+                if known:
                     spans = msg.get("spans")
                     if spans:
                         from ..utils.trace import TRACER
@@ -942,6 +1006,7 @@ class ComputeController:
             elif kind == "PeekResponse":
                 pid = msg["peek_id"]
                 with self._lock:
+                    _lockcheck.shared_write("controller.peek_events")
                     ev = self._peek_events.get(pid)
                     if ev is not None and pid not in self._peek_results:
                         self._peek_results[pid] = msg  # first wins
@@ -953,6 +1018,8 @@ class ComputeController:
         a replica that has not reported yet (still hydrating) counts as
         0, so the definite frontier never overstates."""
         with self._lock:
+            _lockcheck.shared_read("controller.replicas")
+            _lockcheck.shared_read("controller.observed")
             if not self.replicas:
                 return 0
             per = self.frontiers.get(dataflow, {})
@@ -964,6 +1031,7 @@ class ComputeController:
         two reads straddling an increment are separated by at least
         one committed span."""
         with self._lock:
+            _lockcheck.shared_read("controller.observed")
             per = self.span_epochs.get(dataflow)
             return max(per.values()) if per else 0
 
@@ -971,6 +1039,7 @@ class ComputeController:
         """The serving frontier: MAX over replicas (some replica can
         answer at this time)."""
         with self._lock:
+            _lockcheck.shared_read("controller.observed")
             per = self.frontiers.get(dataflow)
             return max(per.values()) if per else 0
 
@@ -983,16 +1052,17 @@ class ComputeController:
         replica is connected."""
         from .freshness import FRESHNESS
 
-        live = [
-            r
-            for r, rc in self.replicas.items()
-            if rc.connected.is_set()
-        ]
+        with self._lock:
+            _lockcheck.shared_read("controller.replicas")
+            live = [
+                r
+                for r, rc in self.replicas.items()
+                if rc.connected.is_set()
+            ]
+            per_frontier = dict(self.frontiers.get(dataflow, {}))
         if not live:
             return None
         summary = FRESHNESS.summary()
-        with self._lock:
-            per_frontier = dict(self.frontiers.get(dataflow, {}))
         best, best_key = None, None
         for r in sorted(live):
             s = summary.get((dataflow, r))
@@ -1039,19 +1109,16 @@ class ComputeController:
         per-dataflow install/rebuild/reconcile counts the replicas
         piggyback on their frontier reports."""
         with self._lock:
+            _lockcheck.shared_read("controller.replicas")
+            _lockcheck.shared_read("controller.observed")
             dataflows = {
                 df: {rep: dict(v) for rep, v in per.items()}
                 for df, per in self.recovery_stats.items()
             }
-        replicas = {
-            name: {
-                "sessions": rc.sessions,
-                "reconnects": max(rc.sessions - 1, 0),
-                "fenced": rc.fenced,
-                "connected": rc.connected.is_set(),
-            }
-            for name, rc in self.replicas.items()
-        }
+            clients = list(self.replicas.items())
+        # Counter reads go through ReplicaClient.stats() (its own leaf
+        # lock): the connection thread increments them mid-session.
+        replicas = {name: rc.stats() for name, rc in clients}
         return {"replicas": replicas, "dataflows": dataflows}
 
     def shutdown(self) -> None:
@@ -1060,5 +1127,8 @@ class ComputeController:
         from ..repr.schema import GLOBAL_DICT
 
         GLOBAL_DICT.remove_rebalance_listener(self._rebalance_listener)
-        for rc in self.replicas.values():
+        with self._lock:
+            _lockcheck.shared_read("controller.replicas")
+            clients = list(self.replicas.values())
+        for rc in clients:
             rc.stop()

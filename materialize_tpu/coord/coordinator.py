@@ -787,7 +787,11 @@ class Coordinator:
                 # leave the override present (two live records replay
                 # newest-wins), never absent — losing an acknowledged
                 # SET across restart is exactly the bug class this
-                # catalog exists to prevent.
+                # catalog exists to prevent. The interleaving explorer
+                # checks this window exhaustively — every crash point
+                # in every schedule, retract-first shown to lose the
+                # var (analysis/interleave.SetCrashModel; the
+                # check_plans --bench `interleave-smoke` gate).
                 prior = self._dyncfg_records.pop(plan.name, None)
                 self._dyncfg_records[plan.name] = self._record_ddl(
                     sql, {"set": plan.name}

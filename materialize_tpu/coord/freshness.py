@@ -47,6 +47,7 @@ import time as _time
 from collections import deque
 from dataclasses import dataclass
 
+from ..utils import lockcheck as _lockcheck
 from ..utils.lockcheck import tracked_lock
 
 # Bounded rings: the history holds the newest HISTORY_CAPACITY commit
@@ -206,6 +207,7 @@ class FreshnessRecorder:
             os.getpid(),
         )
         with self._lock:
+            _lockcheck.shared_write("freshness.lag_rings")
             self._buf.append(rec)
             key = (dataflow, replica)
             win = self._windows.get(key)
@@ -255,6 +257,7 @@ class FreshnessRecorder:
         if at is None:
             at = _time.time()
         with self._lock:
+            _lockcheck.shared_write("freshness.lag_rings")
             self._events.append((obj, replica, kind, float(lag), at))
 
     # -- ship / ingest (the Frontiers piggyback) ----------------------------
@@ -265,6 +268,7 @@ class FreshnessRecorder:
 
     def drain_shippable(self) -> list:
         with self._lock:
+            _lockcheck.shared_write("freshness.lag_rings")
             if not self._ship:
                 return []
             out, self._ship = list(self._ship), deque(
@@ -282,6 +286,7 @@ class FreshnessRecorder:
             if rec.pid == me:
                 continue
             with self._lock:
+                _lockcheck.shared_write("freshness.lag_rings")
                 self._buf.append(rec)
                 key = (rec.dataflow, rec.replica)
                 win = self._windows.get(key)
@@ -302,6 +307,7 @@ class FreshnessRecorder:
     def history_rows(self) -> list:
         """Newest-last (dataflow, replica, frontier, lag_ms, at)."""
         with self._lock:
+            _lockcheck.shared_read("freshness.lag_rings")
             return [
                 (r.dataflow, r.replica, r.frontier, r.lag_ms, r.at)
                 for r in self._buf
@@ -312,6 +318,7 @@ class FreshnessRecorder:
         are nearest-rank over the per-key window (pinned semantics:
         :func:`quantile`)."""
         with self._lock:
+            _lockcheck.shared_read("freshness.lag_rings")
             windows = {k: list(v) for k, v in self._windows.items()}
             latest = dict(self._latest)
         out = {}
@@ -333,6 +340,7 @@ class FreshnessRecorder:
     def latest(self, dataflow: str) -> dict:
         """replica -> (frontier, lag_ms, at) for one dataflow."""
         with self._lock:
+            _lockcheck.shared_read("freshness.lag_rings")
             return {
                 r: v
                 for (df, r), v in self._latest.items()
@@ -342,6 +350,7 @@ class FreshnessRecorder:
     def events_rows(self) -> list:
         """Newest-last (object, replica, kind, lag_ms, at)."""
         with self._lock:
+            _lockcheck.shared_read("freshness.lag_rings")
             return list(self._events)
 
     def forget(self, dataflow: str) -> None:

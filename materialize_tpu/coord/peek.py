@@ -45,7 +45,10 @@ class ServerBusy(RuntimeError):
     """Admission control shed a read: the peek queue is full or too
     many gather batches are in flight. Surfaced as SQLSTATE 53400 at
     pgwire and HTTP 503 — a clean, retryable overload signal instead of
-    an unbounded backlog."""
+    an unbounded backlog. The flush-vs-shed hand-off (every submitted
+    peek either resolves or sheds with THIS error, never silently
+    drops) is model-checked over all interleavings by
+    ``analysis/interleave.BatcherModel``."""
 
 
 class PeekTimedOut(ServerBusy):

@@ -48,7 +48,13 @@ def hard_close(sock: socket.socket) -> None:
     controller hanging "connected" forever (found by the ISSUE 10
     chaos harness: frame-kill storms wedged exactly here).
     ``shutdown(SHUT_RDWR)`` takes effect immediately regardless of
-    concurrent readers, waking them with EOF; the close then lands."""
+    concurrent readers, waking them with EOF; the close then lands.
+
+    The interleaving explorer keeps this wedge as a standing
+    regression fixture: ``analysis/interleave.WedgeModel`` rediscovers
+    it exhaustively on a bare ``close()`` (one-step minimal schedule)
+    and proves every schedule through THIS function wakes the reader
+    (tests/test_interleave.py)."""
     try:
         sock.shutdown(socket.SHUT_RDWR)
     except OSError:

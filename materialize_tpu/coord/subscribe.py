@@ -50,6 +50,7 @@ from collections import deque
 
 from ..expr import relation as mir
 from ..sql.hir import PlanError
+from ..utils import lockcheck as _lockcheck
 from .peek import ServerBusy
 from .protocol import DataflowDescription
 
@@ -402,6 +403,7 @@ class _SharedTail:
             )
             stamp = _time.monotonic()
             with self._lock:
+                _lockcheck.shared_read("subscribe.sessions")
                 self.readbacks += 1
                 self.spans += 1
                 self.frontier = upper
@@ -487,11 +489,13 @@ class _SharedTail:
                         self.frontier = max(
                             self.frontier, snapshot_at + 1
                         )
+            _lockcheck.shared_write("subscribe.sessions")
             self.sessions[session.session_id] = session
 
     def remove_session(self, session_id: int) -> bool:
         """Returns True when this tail just became empty."""
         with self._lock:
+            _lockcheck.shared_write("subscribe.sessions")
             self.sessions.pop(session_id, None)
             return not self.sessions
 
@@ -517,6 +521,7 @@ class _SharedTail:
 
     def stats(self) -> dict:
         with self._lock:
+            _lockcheck.shared_read("subscribe.sessions")
             return {
                 "label": self.label,
                 "sessions": len(self.sessions),
@@ -564,10 +569,19 @@ class SubscribeHub:
 
     # -- admission + sharing -------------------------------------------------
     def session_count(self) -> int:
+        # Each tail's session table is guarded by the TAIL lock, not
+        # the hub lock — reading it under only the hub lock was a race
+        # against add/remove_session (detector finding, ISSUE 17).
+        # Hub -> tail nesting matches close_session's established
+        # order.
         with self._lock:
-            return sum(
-                len(t.sessions) for t in self._tails.values()
-            )
+            tails = list(self._tails.values())
+        n = 0
+        for t in tails:
+            with t._lock:
+                _lockcheck.shared_read("subscribe.sessions")
+                n += len(t.sessions)
+        return n
 
     def subscribe(
         self,
@@ -823,22 +837,27 @@ class SubscribeHub:
         subscription's dataflow reads: close every affected session
         (their shard would never advance again otherwise)."""
         with self._lock:
-            victims = [
-                s
+            affected = [
+                t
                 for t in self._tails.values()
                 if t.label in doomed or (t.deps & doomed)
-                for s in list(t.sessions.values())
             ]
+        victims = []
+        for t in affected:
+            with t._lock:
+                _lockcheck.shared_read("subscribe.sessions")
+                victims.extend(t.sessions.values())
         for s in victims:
             self.close_session(s)
 
     def shutdown(self) -> None:
         with self._lock:
-            victims = [
-                s
-                for t in list(self._tails.values())
-                for s in list(t.sessions.values())
-            ]
+            tails = list(self._tails.values())
+        victims = []
+        for t in tails:
+            with t._lock:
+                _lockcheck.shared_read("subscribe.sessions")
+                victims.extend(t.sessions.values())
         for s in victims:
             self.close_session(s)
 

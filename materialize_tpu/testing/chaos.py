@@ -673,6 +673,67 @@ def seeded_fault_plan(
 
 
 # ---------------------------------------------------------------------------
+# explorer trace replay (ISSUE 17): interleave.Violation -> fault plan
+# ---------------------------------------------------------------------------
+
+
+def trace_seed(trace: dict) -> int:
+    """Deterministic storm seed for an explorer trace: crc32 of the
+    minimal schedule, so the same violation always replays the same
+    wall-clock storm."""
+    import zlib
+
+    schedule = trace.get("schedule") or [
+        s.get("task", "") for s in trace.get("steps", [])
+    ]
+    return zlib.crc32("|".join(schedule).encode()) & 0x7FFFFFFF
+
+
+def fault_plan_from_trace(trace: dict, ticks: int) -> dict:
+    """Map an explorer schedule trace (``interleave.Violation
+    .to_trace()``) onto this harness's fault plan.
+
+    The explorer runs a virtual-time model, so its step indices become
+    tick positions: step *i* of an *n*-step minimal schedule lands at
+    the proportional tick inside the same middle-third-outward window
+    ``seeded_fault_plan`` uses. Three things transfer:
+
+    - ``Op(chaos=...)`` tags become that chaos action at the step's
+      tick (JSON round-trips tuples to lists; both are accepted);
+    - a crash branch (``crash_after``) becomes ``kill_conns`` at the
+      crash step's tick — abrupt connection death is the wall-clock
+      analogue of the model stopping at a durable-write boundary;
+    - the residual storm (background DDL churn, extra conn kills)
+      comes from ``seeded_fault_plan`` keyed on :func:`trace_seed`,
+      merged in, so the replay exercises the full harness even for
+      traces that tag no faults of their own.
+    """
+    steps = trace.get("steps") or []
+    lo, hi = max(1, ticks // 6), max(2, ticks - 2)
+    span = max(1, hi - lo)
+    n = max(1, len(steps))
+
+    def tick_for(i: int) -> int:
+        return min(hi - 1, lo + (int(i) * span) // n)
+
+    plan: dict = {}
+    for i, s in enumerate(steps):
+        action = s.get("chaos")
+        if action is None:
+            continue
+        if isinstance(action, list):
+            action = tuple(action)
+        plan.setdefault(tick_for(i), []).append(action)
+    crash_after = trace.get("crash_after")
+    if crash_after is not None:
+        plan.setdefault(tick_for(crash_after), []).append("kill_conns")
+    base = seeded_fault_plan(trace_seed(trace), ticks)
+    for t, actions in base.items():
+        plan.setdefault(t, []).extend(actions)
+    return plan
+
+
+# ---------------------------------------------------------------------------
 # the subscriber storm (ISSUE 11): push-plane lifecycle under churn
 # ---------------------------------------------------------------------------
 
@@ -1018,11 +1079,21 @@ def run_chaos(
     proxy_kill_every: int = 0,
     replica_kills: int = 0,
     verify_timeout: float = 180.0,
+    replay_trace: dict | None = None,
 ) -> ChaosReport:
     """One seeded chaos run end to end: build the driver, run the
     storm under the seeded fault plan, verify, tear down. The
     ``check_plans.py --bench`` smoke gate and the pytest chaos lane
-    both enter here."""
+    both enter here.
+
+    ``replay_trace`` (ISSUE 17): an explorer schedule trace
+    (``interleave.Violation.to_trace()``, or the same dict loaded from
+    JSON). The trace pins BOTH the storm seed (:func:`trace_seed`) and
+    the fault plan (:func:`fault_plan_from_trace`), so an interleaving
+    the explorer flagged replays wall-clock in the real-thread
+    harness."""
+    if replay_trace is not None:
+        seed = trace_seed(replay_trace)
     driver = ChaosDriver(
         data_dir,
         seed=seed,
@@ -1031,12 +1102,66 @@ def run_chaos(
         proxy_kill_every=proxy_kill_every,
     )
     try:
-        plan = seeded_fault_plan(
-            seed,
-            ticks,
-            replica_kills=replica_kills if subprocess_replica else 0,
-        )
+        if replay_trace is not None:
+            plan = fault_plan_from_trace(replay_trace, ticks)
+        else:
+            plan = seeded_fault_plan(
+                seed,
+                ticks,
+                replica_kills=(
+                    replica_kills if subprocess_replica else 0
+                ),
+            )
         driver.run_storm(ticks=ticks, fault_plan=plan)
         return driver.verify(timeout=verify_timeout)
     finally:
         driver.shutdown()
+
+
+def _main(argv=None) -> int:
+    """``python -m materialize_tpu.testing.chaos --replay-trace t.json``
+    — replay an explorer-emitted schedule trace wall-clock. Without
+    ``--replay-trace`` this runs one ordinary seeded storm."""
+    import argparse
+    import json
+    import tempfile
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--replay-trace",
+        help="path to an interleave.Violation.to_trace() JSON file "
+        "('-' reads stdin); pins the storm seed and fault plan",
+    )
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ticks", type=int, default=60)
+    ap.add_argument("--subprocess-replica", action="store_true")
+    args = ap.parse_args(argv)
+
+    trace = None
+    if args.replay_trace:
+        if args.replay_trace == "-":
+            trace = json.load(sys.stdin)
+        else:
+            with open(args.replay_trace) as f:
+                trace = json.load(f)
+        print(
+            f"replaying trace: model={trace.get('model')!r} "
+            f"kind={trace.get('kind')!r} "
+            f"schedule={len(trace.get('steps', []))} steps "
+            f"seed={trace_seed(trace)}"
+        )
+    with tempfile.TemporaryDirectory() as tmp:
+        rep = run_chaos(
+            args.data_dir or tmp,
+            seed=args.seed,
+            ticks=args.ticks,
+            subprocess_replica=args.subprocess_replica,
+            replay_trace=trace,
+        )
+    print(rep)
+    return 0 if rep.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

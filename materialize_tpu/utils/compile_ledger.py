@@ -48,10 +48,11 @@ from __future__ import annotations
 
 import hashlib
 import os
-import threading
 import time as _time
 from collections import deque
 from dataclasses import dataclass, field
+
+from . import lockcheck
 
 
 @dataclass
@@ -92,7 +93,12 @@ class CompileLedger:
     SEEN_CAP = 32768
 
     def __init__(self, capacity: int = 4096):
-        self._lock = threading.Lock()
+        # Tracked (ISSUE 17): every ledger_jit site in any thread takes
+        # this lock; the race detector also watches the _seen memory
+        # through the lockcheck shared-state shims.
+        from .lockcheck import tracked_lock
+
+        self._lock = tracked_lock("compile.ledger")
         self._buf: deque[CompileRecord] = deque(maxlen=capacity)
         self._ingested: deque[CompileRecord] = deque(maxlen=capacity)
         self._seen: dict = {}  # insertion-ordered: FIFO eviction
@@ -153,6 +159,7 @@ class CompileLedger:
     ) -> CompileRecord:
         key = (kind, fingerprint, tier)
         with self._lock:
+            lockcheck.shared_write("compile_ledger.seen")
             if cache is None:
                 if key in self._seen:
                     cache = "hit"
@@ -274,6 +281,7 @@ class CompileLedger:
 
     def clear(self) -> None:
         with self._lock:
+            lockcheck.shared_write("compile_ledger.seen")
             self._buf.clear()
             self._ingested.clear()
             self._seen.clear()

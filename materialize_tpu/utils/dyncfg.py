@@ -11,9 +11,10 @@ update stream (compute_state.rs:46-59 discipline).
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from typing import Any
+
+from . import lockcheck
 
 
 @dataclass
@@ -53,6 +54,7 @@ class ConfigSet:
 
     def get(self, name: str):
         with self._lock:
+            lockcheck.shared_read("dyncfg.values")
             if name in self._values:
                 return self._values[name]
             return self._configs[name].default
@@ -62,6 +64,7 @@ class ConfigSet:
         know them); returns the full current value map for shipping to
         replicas."""
         with self._lock:
+            lockcheck.shared_write("dyncfg.values")
             for k, v in values.items():
                 if v is None:
                     # None RESETS to the default (a stored None would
@@ -82,6 +85,7 @@ class ConfigSet:
 
     def current(self) -> dict:
         with self._lock:
+            lockcheck.shared_read("dyncfg.values")
             out = {n: c.default for n, c in self._configs.items()}
             out.update(self._values)
             return out
@@ -286,6 +290,20 @@ BUFFER_SANITIZER = Config(
     "use-after-donate bugs on hosts where real donation is not even "
     "wired. Production default off (one ledger walk per donated "
     "dispatch)",
+).register(COMPUTE_CONFIGS)
+
+RACE_DETECTOR = Config(
+    "race_detector", False,
+    "happens-before race detector (analysis/racecheck.py): vector-"
+    "clock instrumentation layered on lockcheck's tracked-lock "
+    "acquire/release hooks plus the declared-shared-state registry "
+    "(controller maps, hub session tables, freshness rings, "
+    "compile-ledger memory, this dyncfg store), reporting "
+    "unsynchronized read/write pairs with both stack chains. Default "
+    "ON under `pytest -m analysis` (tests/conftest.py) and in the "
+    "check_plans.py --bench race-free gate; production default off "
+    "(one module-global None check per declared access, same "
+    "discipline as buffer_sanitizer)",
 ).register(COMPUTE_CONFIGS)
 
 # -- the push serving plane (ISSUE 11 / ROADMAP item 3) ----------------------

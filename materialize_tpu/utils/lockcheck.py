@@ -37,6 +37,15 @@ from dataclasses import dataclass
 # torn read just misses one edge during enable/disable — benign).
 _ENABLED = False
 
+# The happens-before race detector (analysis/racecheck.py) layers on
+# these hook slots: one module-global read per acquire/release/shared-
+# access when it is off (None), so production pays nothing. The
+# detector installs itself via set_racecheck() on enable. Keeping the
+# slots HERE (not in analysis/) lets every hot module instrument its
+# declared shared state through the already-imported lockcheck module
+# without pulling the heavyweight analysis package onto the hot path.
+_RACECHECK = None
+
 # The order graph + findings, guarded by a LEAF lock that is itself
 # never tracked (no recursion, no ordering constraints against it).
 _graph_lock = threading.Lock()
@@ -173,6 +182,41 @@ def held_names() -> tuple:
     return tuple(n for n, _ in _held())
 
 
+# -- race-detector hook slots -------------------------------------------------
+
+
+def set_racecheck(hooks) -> None:
+    """Install (or remove, with None) the happens-before race detector.
+    ``hooks`` is any object with on_acquire/on_release/on_read/on_write
+    (analysis/racecheck installs its own module)."""
+    global _RACECHECK
+    _RACECHECK = hooks
+
+
+def shared_read(name: str) -> None:
+    """Instrumentation shim for a READ of declared shared state. One
+    global load + None check when the race detector is off."""
+    rc = _RACECHECK
+    if rc is not None:
+        rc.on_read(name)
+
+
+def shared_write(name: str) -> None:
+    """Instrumentation shim for a WRITE of declared shared state."""
+    rc = _RACECHECK
+    if rc is not None:
+        rc.on_write(name)
+
+
+def registered_names() -> set:
+    """Every tracked-lock name ever constructed in this process — the
+    tracked-object registry the interleaving explorer keys its DPOR
+    independence relation on (analysis/interleave.py) and the race
+    detector uses to seed lock clocks."""
+    with _graph_lock:
+        return set(_REGISTRY)
+
+
 # -- tracked lock wrappers ---------------------------------------------------
 
 
@@ -189,15 +233,27 @@ class TrackedLock:
         self.sequencing = sequencing
         if sequencing:
             _SEQUENCING_NAMES.add(name)
+        with _graph_lock:
+            _REGISTRY.add(name)
         self._lock = self._factory()
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
         got = self._lock.acquire(blocking, timeout)
-        if got and _ENABLED:
-            _record_acquire(self.name)
+        if got:
+            if _ENABLED:
+                _record_acquire(self.name)
+            rc = _RACECHECK
+            if rc is not None:
+                rc.on_acquire(self.name)
         return got
 
     def release(self) -> None:
+        # The race detector snapshots the releasing thread's vector
+        # clock while the lock is STILL held (the release publishes
+        # everything this thread did under it).
+        rc = _RACECHECK
+        if rc is not None:
+            rc.on_release(self.name)
         if _ENABLED:
             _record_release(self.name)
         self._lock.release()
@@ -285,3 +341,6 @@ def device_dispatch(where: str) -> None:
 # constructed) and extended by every tracked lock built with
 # sequencing=True.
 _SEQUENCING_NAMES = {"coord.sequencing"}
+
+# Every tracked-lock name ever constructed (see registered_names()).
+_REGISTRY: set = set()

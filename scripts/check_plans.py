@@ -310,6 +310,8 @@ def run_bench_mode(verbose: bool) -> int:
     rc |= run_mz_relations_gate(gate)
     rc |= run_bank_roundtrip_gate(gate)
     rc |= run_tier_quantization_gate(gate)
+    rc |= run_race_free_gate(gate)
+    rc |= run_interleave_smoke_gate(gate)
     return rc
 
 
@@ -1194,6 +1196,132 @@ def run_lockcheck_smoke(gate) -> int:
         for f in lockcheck.findings()
     ]
     gate("lockcheck-smoke", None, findings, 0)
+    return 1 if findings else 0
+
+
+def run_race_free_gate(gate) -> int:
+    """Happens-before race gate (ISSUE 17): drive the ordinary
+    serving path AND the subscribe push plane with the vector-clock
+    detector on (dyncfg ``race_detector``, analysis/racecheck.py) and
+    gate on ZERO unsuppressed findings over the declared shared-state
+    set — the controller maps, the hub session tables, the freshness
+    rings, the compile ledger, the dyncfg store. A finding here is an
+    access pair with no happens-before edge: a real (if maybe narrow)
+    race, reported with both stack chains."""
+    import shutil
+    import tempfile
+    import threading
+    import time as _t
+
+    from materialize_tpu.analysis import LintFinding, racecheck
+    from materialize_tpu.utils import lockcheck
+    from materialize_tpu.utils.dyncfg import COMPUTE_CONFIGS
+
+    COMPUTE_CONFIGS.update({"race_detector": True})
+    lockcheck.enable()
+    racecheck.maybe_enable_from_dyncfg(reset=True)
+    coord = None
+    tmp = tempfile.mkdtemp(prefix="race-free-gate-")
+    try:
+        from materialize_tpu.coord.coordinator import Coordinator
+        from materialize_tpu.coord.protocol import PersistLocation
+        from materialize_tpu.coord.replica import serve_forever
+        from materialize_tpu.storage.persist import (
+            FileBlob,
+            PersistClient,
+            SqliteConsensus,
+        )
+        from materialize_tpu.testing.chaos import _free_port
+
+        loc = PersistLocation(
+            os.path.join(tmp, "blob"), os.path.join(tmp, "c.db")
+        )
+        port = _free_port()
+        ready = threading.Event()
+        threading.Thread(
+            target=serve_forever,
+            args=(port, loc, "r0", ready),
+            daemon=True,
+        ).start()
+        ready.wait(10)
+        coord = Coordinator(
+            PersistClient(
+                FileBlob(loc.blob_root),
+                SqliteConsensus(loc.consensus_path),
+            ),
+            tick_interval=None,
+        )
+        coord.add_replica("r0", ("127.0.0.1", port))
+        coord.execute("CREATE TABLE rt (a BIGINT, b BIGINT)")
+        coord.execute("INSERT INTO rt VALUES (1, 2), (3, 4)")
+        coord.execute(
+            "CREATE MATERIALIZED VIEW rmv AS SELECT a, b FROM rt"
+        )
+        coord.execute("SELECT * FROM rmv")
+        coord.execute("SELECT * FROM rmv WHERE a = 1")
+        sub = coord.execute(
+            "SUBSCRIBE TO (SELECT a, b FROM rt WHERE a >= 0)"
+        ).subscription
+        coord.execute("INSERT INTO rt VALUES (5, 6)")
+        final = coord._table_writers["rt"].upper
+        deadline = _t.monotonic() + 60.0
+        while sub.frontier < final and _t.monotonic() < deadline:
+            sub.pop_ready()
+            _t.sleep(0.01)
+        sub.close()
+        coord.execute("SELECT * FROM mz_donation")
+        _t.sleep(0.2)  # let absorber/tail threads run a few passes
+    except OSError as e:
+        print(f"race-free: skipped (environment: {e!r})")
+        return 0
+    finally:
+        if coord is not None:
+            coord.shutdown()
+        racecheck.disable()
+        lockcheck.disable()
+        COMPUTE_CONFIGS.update({"race_detector": False})
+        shutil.rmtree(tmp, ignore_errors=True)
+    findings = [
+        LintFinding("racecheck", f.kind, str(f))
+        for f in racecheck.findings()
+    ]
+    gate("race-free", None, findings, 0)
+    return 1 if findings else 0
+
+
+def run_interleave_smoke_gate(gate) -> int:
+    """Interleaving-explorer gate (ISSUE 17): exhaustively check the
+    two protocol models whose state spaces are small enough for CI —
+    the epoch-fencing handshake (real ``_NonceSource``) and the
+    catalog SET append-then-retract crash window (every crash point in
+    every surviving schedule). Fails on any violation, wedge, or
+    truncation; the explored-state counts are printed so a model edit
+    that silently collapses coverage is visible in the gate output."""
+    from materialize_tpu.analysis import LintFinding
+    from materialize_tpu.analysis.interleave import MODELS, explore
+
+    findings = []
+    for name in ("fencing", "set-crash-window"):
+        res = explore(MODELS[name], crash=True)
+        print(
+            f"interleave-smoke: {name}: {res.schedules} schedules, "
+            f"{res.crash_branches} crash branches, {res.steps} steps"
+        )
+        if res.truncated:
+            findings.append(
+                LintFinding(
+                    "interleave", "truncated",
+                    f"{name}: state space truncated at "
+                    f"{res.schedules} schedules — the model grew past "
+                    "the exhaustive budget; shrink it or raise "
+                    "max_schedules deliberately",
+                )
+            )
+        for v in res.violations:
+            findings.append(
+                LintFinding("interleave", v.kind, v.format())
+            )
+    gate("interleave-smoke", None, findings, 0)
     return 1 if findings else 0
 
 

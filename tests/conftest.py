@@ -91,6 +91,17 @@ def pytest_configure(config):
     markexpr = (getattr(config.option, "markexpr", "") or "").strip()
     if re.search(r"(?<!not )\banalysis\b", markexpr):
         COMPUTE_CONFIGS.update({"buffer_sanitizer": True})
+        # The happens-before race detector rides the same lane (ISSUE
+        # 17): declared shared state across the whole suite is checked
+        # for unsynchronized access pairs; tests read
+        # racecheck.findings() to assert clean (or reproduce a fixed
+        # race). Production default off — one None check per access.
+        COMPUTE_CONFIGS.update({"race_detector": True})
+        from materialize_tpu.analysis import racecheck
+        from materialize_tpu.utils import lockcheck
+
+        lockcheck.enable()
+        racecheck.maybe_enable_from_dyncfg(reset=True)
 
 
 # -- replica-worker leak control ---------------------------------------------

@@ -1,0 +1,164 @@
+"""Interleaving-explorer tests (ISSUE 17 tentpole a): the DPOR
+scheduler model-checks the coordination protocols exhaustively —
+fencing, the catalog SET crash window, the hard_close wedge,
+reconciliation, peek batching, subscribe teardown — and the two
+standing regression fixtures (bare-close wedge, retract-first SET)
+must still be FOUND, with minimal traces."""
+
+import json
+
+import pytest
+
+from materialize_tpu.analysis.interleave import (
+    MODELS,
+    BatcherModel,
+    FencingModel,
+    HubModel,
+    ReconcileModel,
+    SetCrashModel,
+    WedgeModel,
+    explore,
+)
+
+pytestmark = pytest.mark.analysis
+
+
+class TestFencing:
+    def test_exhaustive_and_clean(self):
+        """Two controller generations x two commands against the real
+        _NonceSource: every interleaving keeps applied epochs
+        monotone with no double-apply. The exact state-space count is
+        pinned — a model edit that collapses coverage (or a DPOR bug
+        that stops exploring) fails loudly, not silently."""
+        res = explore(FencingModel)
+        assert res.ok, res.summary() + "\n" + "\n".join(
+            v.format() for v in res.violations
+        )
+        assert not res.truncated
+        assert res.schedules == 19
+        assert res.steps == 120
+
+    def test_uses_real_nonce_source(self):
+        m = FencingModel()
+        # the real controller nonce source, not a model stand-in
+        from materialize_tpu.coord.controller import _NonceSource
+
+        assert isinstance(m.src, _NonceSource)
+
+
+class TestSetCrashWindow:
+    def test_append_then_retract_survives_every_crash(self):
+        """The shipped order (append new, then retract prior): every
+        crash point in every schedule leaves the var recoverable by
+        newest-id-wins replay."""
+        res = explore(SetCrashModel)
+        assert res.ok, "\n".join(v.format() for v in res.violations)
+        assert res.crash_branches == 4  # one per durable write
+        assert not res.truncated
+
+    def test_retract_first_loses_the_var(self):
+        """The regression fixture: retract-before-append has a crash
+        window where the override vanishes — the explorer must find
+        it and mark the crash point in the trace."""
+        res = explore(lambda: SetCrashModel(retract_first=True))
+        assert not res.ok
+        kinds = {v.kind for v in res.violations}
+        assert "crash" in kinds
+        v = next(v for v in res.violations if v.kind == "crash")
+        assert v.crash_after is not None
+        assert "CRASH HERE" in v.format()
+
+
+class TestCloseWedge:
+    def test_bare_close_wedges_with_minimal_trace(self):
+        """The ISSUE 10 wedge, found exhaustively: a bare close()
+        while the reader blocks in recv never wakes it. The minimal
+        counterexample is a single fencer step."""
+        res = explore(lambda: WedgeModel(hard_close=False), crash=False)
+        assert not res.ok
+        v = res.violations[0]
+        assert v.kind == "wedge"
+        assert len(v.schedule) == 1, v.format()
+        assert "reader" in v.message
+
+    def test_hard_close_is_wedge_free(self):
+        """Every schedule through the real protocol.hard_close wakes
+        the reader — the shutdown-before-close fix, proven over the
+        whole interleaving space instead of one chaos run."""
+        res = explore(lambda: WedgeModel(hard_close=True), crash=False)
+        assert res.ok, "\n".join(v.format() for v in res.violations)
+
+
+class TestReconcileAndBatcherAndHub:
+    def test_reconcile_never_rerenders(self):
+        res = explore(ReconcileModel)
+        assert res.ok, "\n".join(v.format() for v in res.violations)
+
+    def test_batcher_never_loses_a_peek(self):
+        res = explore(BatcherModel, crash=False)
+        assert res.ok, "\n".join(v.format() for v in res.violations)
+        assert res.schedules > 1  # submit/flush orders genuinely vary
+
+    def test_locked_hub_drops_exactly_once(self):
+        res = explore(lambda: HubModel(locked=True), crash=False)
+        assert res.ok, "\n".join(v.format() for v in res.violations)
+
+    def test_unlocked_hub_double_drops(self):
+        """check-then-pop across an interleaving point: the explorer
+        finds the double drop the hub lock exists to prevent."""
+        res = explore(lambda: HubModel(locked=False), crash=False)
+        assert not res.ok
+        assert any("drop" in v.message for v in res.violations)
+
+
+class TestChaosBridge:
+    def test_trace_round_trips_to_a_fault_plan(self):
+        """Satellite 4: a violation trace JSON-round-trips into a
+        deterministic wall-clock fault plan (testing/chaos.py
+        --replay-trace): the crash point lands as kill_conns inside
+        the storm's fault window, and the same trace always yields
+        the same plan and seed."""
+        from materialize_tpu.testing.chaos import (
+            fault_plan_from_trace,
+            trace_seed,
+        )
+
+        res = explore(lambda: SetCrashModel(retract_first=True))
+        v = next(x for x in res.violations if x.kind == "crash")
+        trace = json.loads(json.dumps(v.to_trace()))
+        assert trace["model"] == "set-crash-window"
+        assert trace["crash_after"] is not None
+
+        ticks = 60
+        plan = fault_plan_from_trace(trace, ticks)
+        assert plan == fault_plan_from_trace(trace, ticks)
+        assert trace_seed(trace) == trace_seed(v.to_trace())
+        lo, hi = max(1, ticks // 6), max(2, ticks - 2)
+        assert plan and all(lo <= t < hi for t in plan)
+        actions = [a for acts in plan.values() for a in acts]
+        assert "kill_conns" in actions  # the crash point transferred
+
+    def test_replay_trace_pins_run_chaos_seed(self):
+        """run_chaos(replay_trace=...) derives its storm seed from the
+        trace, ignoring the seed argument — a flagged interleaving
+        replays the same storm no matter who invokes it."""
+        from materialize_tpu.testing.chaos import trace_seed
+
+        res = explore(lambda: WedgeModel(hard_close=False), crash=False)
+        t1 = res.violations[0].to_trace()
+        res2 = explore(lambda: WedgeModel(hard_close=False), crash=False)
+        t2 = res2.violations[0].to_trace()
+        assert trace_seed(t1) == trace_seed(t2)
+
+
+class TestNamedModels:
+    def test_every_named_model_is_explorable(self):
+        """The MODELS registry (the gate's menu) stays runnable: every
+        factory explores without truncation. Only the two fixture
+        models are allowed (and expected) to violate."""
+        for name, factory in MODELS.items():
+            res = explore(factory)
+            assert not res.truncated, name
+            assert res.ok, f"{name}: " + "\n".join(
+                v.format() for v in res.violations
+            )

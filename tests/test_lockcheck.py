@@ -113,6 +113,110 @@ class TestDispatchUnderLock:
         assert checker.findings() == []
 
 
+class TestTrackedRegistry:
+    """ISSUE 17 satellite: the post-PR-5 subsystems' locks are
+    tracked, so the order graph (and the explorer's DPOR vocabulary —
+    interleave.registry_objects) actually covers them."""
+
+    def test_new_subsystem_locks_register(self, checker, tmp_path):
+        from materialize_tpu.compile.bank import ProgramBank
+        from materialize_tpu.compile.worker import CompileWorker
+        from materialize_tpu.coord import freshness  # noqa: F401
+        from materialize_tpu.utils import compile_ledger  # noqa: F401
+
+        ProgramBank(str(tmp_path / "bank"))
+        CompileWorker()
+        names = lockcheck.registered_names()
+        for expected in (
+            "compile.bank",
+            "compile.worker",
+            "compile.ledger",
+            "freshness.recorder",
+            "coord.sequencing",
+        ):
+            assert expected in names, expected
+
+    def test_subscribe_locks_register_and_nest_acyclically(
+        self, checker, tmp_path
+    ):
+        """Drive the subscribe path (admission, delivery, census,
+        teardown) and assert (a) the hub/tail/session locks appear in
+        the tracked registry, (b) the WHOLE observed order graph is
+        acyclic — hub -> tail is the one blessed nesting."""
+        import socket
+        import time
+
+        from materialize_tpu.coord.coordinator import Coordinator
+        from materialize_tpu.coord.protocol import PersistLocation
+        from materialize_tpu.coord.replica import serve_forever
+        from materialize_tpu.storage.persist import (
+            FileBlob,
+            PersistClient,
+            SqliteConsensus,
+        )
+
+        loc = PersistLocation(
+            str(tmp_path / "blob"), str(tmp_path / "c.db")
+        )
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        ready = threading.Event()
+        threading.Thread(
+            target=serve_forever,
+            args=(port, loc, "r0", ready),
+            daemon=True,
+        ).start()
+        assert ready.wait(10)
+        coord = Coordinator(
+            PersistClient(
+                FileBlob(loc.blob_root),
+                SqliteConsensus(loc.consensus_path),
+            ),
+            tick_interval=None,
+        )
+        try:
+            coord.add_replica("r0", ("127.0.0.1", port))
+            coord.execute("CREATE TABLE st (a BIGINT, b BIGINT)")
+            coord.execute("INSERT INTO st VALUES (1, 2)")
+            sub = coord.execute(
+                "SUBSCRIBE TO (SELECT a, b FROM st WHERE a >= 0)"
+            ).subscription
+            coord.execute("INSERT INTO st VALUES (3, 4)")
+            final = coord._table_writers["st"].upper
+            deadline = time.monotonic() + 60.0
+            while sub.frontier < final and time.monotonic() < deadline:
+                sub.pop_ready()
+                time.sleep(0.01)
+            coord.subscribe_hub.session_count()
+            sub.close()
+            time.sleep(0.2)
+        finally:
+            coord.shutdown()
+        assert [str(f) for f in checker.findings()] == []
+        edges = checker.edges()
+        assert edges, "no lock orders recorded"
+        # Kahn's algorithm over the observed graph: every node drains.
+        nodes = set(edges) | {n for vs in edges.values() for n in vs}
+        indeg = {n: 0 for n in nodes}
+        for vs in edges.values():
+            for v in vs:
+                indeg[v] += 1
+        queue = [n for n, d in indeg.items() if d == 0]
+        drained = 0
+        while queue:
+            n = queue.pop()
+            drained += 1
+            for v in edges.get(n, ()):
+                indeg[v] -= 1
+                if indeg[v] == 0:
+                    queue.append(v)
+        assert drained == len(nodes), (
+            f"observed lock-order graph has a cycle: {edges}"
+        )
+
+
 class TestServingPathClean:
     def test_span_and_peek_paths_record_zero_findings(
         self, checker, tmp_path
