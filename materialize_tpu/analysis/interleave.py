@@ -926,6 +926,206 @@ class HubModel:
         assert not self.sessions, "session leaked past both closers"
 
 
+class DrainModel:
+    """Routed-read failover exactly-once (ISSUE 19): a replica DRAIN
+    races an in-flight peek that was routed to that replica. The drain
+    re-dispatches the peek to the next candidate, but the drained
+    replica's answer may already be in the response queue — the
+    straggler and the failover target's answer then race to resolve
+    the same waiter. ``dedup=True`` (the shipped controller) makes the
+    check-and-resolve atomic under "controller.peek_events" (first
+    response wins, second is dropped); ``dedup=False`` splits the
+    resolved-check and the resolution across a yield — the explorer
+    must find the double-resolve."""
+
+    name = "replica-drain-peek"
+    # replicas idle at terminal states when never dispatched to
+    daemons = ("r0", "r1")
+
+    def __init__(self, dedup: bool = True):
+        self.dedup = dedup
+        self.dispatched = {"r0"}  # p1 routed to r0 before the drain
+        self.draining: set = set()
+        self.resolved: dict = {}
+        self.resolutions = []  # (peek, replica) — must end length 1
+
+    def tasks(self):
+        return [
+            ("drainer", self._drain()),
+            ("r0", self._answer("r0")),
+            ("r1", self._answer("r1")),
+        ]
+
+    def _drain(self):
+        yield Op("controller.state", "write", "drain:mark(r0)")
+        self.draining.add("r0")
+        # Failover re-dispatch: atomic with the resolved-check (the
+        # real _failover_peek commits the hop under the controller
+        # lock and skips peeks that already resolved).
+        yield Op("controller.peek_events", "write", "drain:failover(p1)")
+        if "p1" not in self.resolved:
+            self.dispatched.add("r1")
+
+    def _answer(self, me):
+        # The absorber processes this replica's answer to p1 once the
+        # peek was ever dispatched to it (stragglers included: a
+        # drained replica's response can arrive after the failover).
+        yield Op(
+            "controller.peek_events", "read", f"{me}:response(p1)",
+            ready=lambda: me in self.dispatched,
+        )
+        if self.dedup:
+            yield Op(
+                "controller.peek_events", "write", f"{me}:resolve(p1)"
+            )
+            if "p1" not in self.resolved:
+                self.resolved["p1"] = me
+                self.resolutions.append(("p1", me))
+        else:
+            # The tempting wrong shape: check outside the lock, then
+            # resolve — both replicas pass the check, both resolve.
+            yield Op(
+                "controller.peek_events", "read", f"{me}:check(p1)"
+            )
+            pending = "p1" not in self.resolved
+            if pending:
+                yield Op(
+                    "controller.peek_events", "write",
+                    f"{me}:resolve(p1)",
+                )
+                self.resolved["p1"] = me
+                self.resolutions.append(("p1", me))
+
+    def invariant(self, crashed: bool = False) -> None:
+        assert len(self.resolutions) == 1, (
+            "routed-peek exactly-once violated: p1 resolved by "
+            f"{[r for _, r in self.resolutions] or 'NOBODY'} — a "
+            "drain must neither lose the waiter nor let the drained "
+            "replica's straggler answer double-resolve it"
+        )
+
+
+class ScaleBandModel:
+    """Autoscaler action racing a rolling restart (ISSUE 19): both
+    mutate the replica set, and the serving invariants are *at every
+    instant* — replica count stays inside [min,max] and at least one
+    serving replica exists. ``locked=True`` (the shipped environment)
+    serializes both actions on "environment.scale" (the real tracked
+    lock) with the restart's abort-if-no-other-serving precondition;
+    ``locked=False`` lets the autoscaler's read-count-then-act span
+    the restart's stop/respawn window — the explorer must find the
+    band overflow (``action="spawn"``: count > max after the restart
+    respawns) or the zero-serving instant (``action="drain"``: the
+    drain lands while the restarted replica is down)."""
+
+    name = "autoscale-band"
+    daemons = ()
+
+    def __init__(
+        self,
+        locked: bool = True,
+        action: str = "spawn",
+        first: str = "restarter",
+    ):
+        assert action in ("spawn", "drain")
+        self.locked = locked
+        self.action = action
+        # Which task the scheduler tries first. A blocked lock acquire
+        # is not an enabled op, so DPOR cannot backtrack into the
+        # other acquisition order on its own — callers cover both
+        # orders explicitly (tests do), including the restart's
+        # abort-when-no-other-serving path.
+        self.first = first
+        self.replicas = {"r0": "serving", "r1": "serving"}
+        self.min_replicas = 1
+        self.max_replicas = 2
+        self.scale_owner = None
+        self.aborted = False
+        self.min_live = len(self.replicas)
+        self.max_count = len(self.replicas)
+
+    def _note(self) -> None:
+        self.min_live = min(self.min_live, len(self.replicas))
+        self.max_count = max(self.max_count, len(self.replicas))
+
+    def tasks(self):
+        out = [
+            ("restarter", self._restart()),
+            ("autoscaler", self._autoscale()),
+        ]
+        if self.first == "autoscaler":
+            out.reverse()
+        return out
+
+    def _restart(self):
+        if self.locked:
+            yield Op(
+                "environment.scale", "write", "restart:lock",
+                ready=lambda: self.scale_owner is None,
+            )
+            self.scale_owner = "restarter"
+        # CHECKED precondition (the real rolling_restart): some OTHER
+        # replica serves, else abort — never a blind stop.
+        yield Op("controller.state", "read", "restart:precondition")
+        if len(self.replicas) - (1 if "r0" in self.replicas else 0) < 1:
+            self.aborted = True
+            if self.locked:
+                yield Op("environment.scale", "write", "restart:unlock")
+                self.scale_owner = None
+            return
+        yield Op("controller.state", "write", "restart:stop(r0)")
+        self.replicas.pop("r0", None)
+        self._note()
+        yield Op("controller.state", "write", "restart:respawn(r0)")
+        self.replicas["r0"] = "serving"
+        self._note()
+        if self.locked:
+            yield Op("environment.scale", "write", "restart:unlock")
+            self.scale_owner = None
+
+    def _autoscale(self):
+        if self.locked:
+            yield Op(
+                "environment.scale", "write", "scale:lock",
+                ready=lambda: self.scale_owner is None,
+            )
+            self.scale_owner = "autoscaler"
+        yield Op("controller.state", "read", "scale:signals")
+        count = len(self.replicas)
+        if self.action == "spawn":
+            decide = "spawn" if count < self.max_replicas else "hold"
+        else:
+            decide = "drain" if count > self.min_replicas else "hold"
+        if decide == "spawn":
+            yield Op("controller.state", "write", "scale:spawn(r2)")
+            self.replicas["r2"] = "serving"
+            self._note()
+        elif decide == "drain":
+            victim = "r1" if "r1" in self.replicas else None
+            yield Op("controller.state", "write", f"scale:drop({victim})")
+            if victim is not None:
+                self.replicas.pop(victim, None)
+            self._note()
+        if self.locked:
+            yield Op("environment.scale", "write", "scale:unlock")
+            self.scale_owner = None
+
+    def invariant(self, crashed: bool = False) -> None:
+        assert self.max_count <= self.max_replicas, (
+            f"autoscale band violated: replica count reached "
+            f"{self.max_count} > max {self.max_replicas} — the spawn "
+            "decision's count read went stale across the restart's "
+            "stop/respawn window"
+        )
+        assert self.min_live >= 1, (
+            "zero serving replicas at some instant: the autoscaler's "
+            "drain landed while the rolling restart had its replica "
+            "down"
+        )
+        if not self.aborted:
+            assert "r0" in self.replicas, "restart never respawned r0"
+
+
 #: Named model factories for the CLI gate / chaos bridge. Values are
 #: callables(**kwargs) -> fresh model.
 MODELS = {
@@ -935,6 +1135,8 @@ MODELS = {
     "reconcile": ReconcileModel,
     "peek-batcher": BatcherModel,
     "subscribe-drop": HubModel,
+    "replica-drain-peek": DrainModel,
+    "autoscale-band": ScaleBandModel,
 }
 
 

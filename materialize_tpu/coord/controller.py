@@ -9,6 +9,17 @@ dataflows (rehydration, ``controller/instance.rs:1379 rehydrate_failed_
 replicas``). Multi-replica peek responses are deduplicated: first
 response wins (``service.rs:271 absorb_peek_response``). Active-active
 replication is exactly this: run >=2 replicas, mask failures.
+
+Reads are ROUTED, not broadcast (ISSUE 19): with ``peek_routing =
+'route'`` (the default) each peek / batched lookup dispatches to the
+single least-lagged hydrated replica (``route_candidates``), and fails
+over to the next candidate immediately on that replica's disconnect —
+or after the ``retry_policy_failover`` per-target stall budget — with
+a terminal one-shot broadcast fallback once the candidate list is
+exhausted. The first-response-wins dedup stays: it is what makes
+re-dispatch (and the broadcast fallback) safe to race a straggler
+answer from the original target. ``peek_routing = 'broadcast'``
+restores the legacy fan-out for comparison.
 """
 
 from __future__ import annotations
@@ -33,6 +44,44 @@ def _batch_resolve_timeout() -> float:
     (retry_policy_peek, mirroring the coordinator's PEEK_TIMEOUT)."""
     b = retry_mod.policy("peek").budget
     return b if b > 0 else 180.0
+
+
+# -- /metrics (lazy registration: module may be imported many times) ---------
+
+
+def _counter(name: str, help_: str):
+    from ..utils.metrics import REGISTRY
+
+    got = REGISTRY.get(name)
+    if got is None:
+        got = REGISTRY.counter(name, help_)
+    return got
+
+
+def routed_peeks_total():
+    return _counter(
+        "mz_peek_routed_total",
+        "peeks/batched lookups dispatched to a single routed replica "
+        "(peek_routing='route') instead of broadcast to all",
+    )
+
+
+def broadcast_avoided_total():
+    return _counter(
+        "mz_peek_broadcast_avoided_total",
+        "duplicate peek dispatches avoided by routing: for each "
+        "routed read, the N-1 replica sends (and discarded responses) "
+        "the legacy broadcast path would have paid",
+    )
+
+
+def peek_failovers_total():
+    return _counter(
+        "mz_peek_failovers_total",
+        "routed reads re-dispatched to another candidate after the "
+        "target disconnected, stalled past retry_policy_failover's "
+        "per-target budget, or started draining",
+    )
 
 
 class _NonceSource:
@@ -330,8 +379,12 @@ class PeekBatcher:
         trace = next(
             (w.trace for w in waiters if w.trace is not None), None
         )
-        ctrl._broadcast(
-            ctp.peek_lookup(peek_id, dataflow, as_of, spec, trace=trace)
+        ctrl._dispatch_peek(
+            peek_id,
+            dataflow,
+            ctp.peek_lookup(
+                peek_id, dataflow, as_of, spec, trace=trace
+            ),
         )
         return _PeekBatch(peek_id, ev, waiters, scan)
 
@@ -341,7 +394,9 @@ class PeekBatcher:
         error = None
         retryable = False
         try:
-            if not batch.event.wait(timeout):
+            if not ctrl._await_peek_event(
+                batch.peek_id, batch.event, timeout
+            ):
                 error = "batched peek timed out"
                 retryable = True
             else:
@@ -356,7 +411,8 @@ class PeekBatcher:
                 _lockcheck.shared_write("controller.peek_events")
                 ctrl._peek_events.pop(batch.peek_id, None)
                 ctrl._peek_results.pop(batch.peek_id, None)
-            ctrl._broadcast(ctp.cancel_peek(batch.peek_id))
+                info = ctrl._inflight_peeks.pop(batch.peek_id, None)
+            ctrl._cancel_peek(batch.peek_id, info)
             with self._lock:
                 self._inflight -= 1
         if error is not None:
@@ -464,7 +520,18 @@ class ReplicaClient:
                 stream = retry_mod.policy("reconnect").stream()
             except (OSError, ctp.TransportError):
                 pass
+            was_connected = self.connected.is_set()
             self.connected.clear()
+            if was_connected and not self._stop.is_set():
+                # Failover trigger (ISSUE 19): the absorber re-routes
+                # this replica's in-flight reads NOW — a waiter must
+                # not ride out the stall timer for a dead session.
+                self._response_q.put(
+                    {
+                        "kind": "ReplicaDisconnected",
+                        "__replica__": self.name,
+                    }
+                )
             if not self._stop.is_set():
                 # Unbounded: reconnect never gives up (an expired
                 # attempts/budget must back off at the ceiling, not
@@ -615,6 +682,22 @@ class ComputeController:
         self.install_acks: dict[str, dict] = {}
         self._peek_results: dict[int, dict] = {}
         self._peek_events: dict[int, threading.Event] = {}
+        # Routed-read state (ISSUE 19, guarded by _lock): per in-flight
+        # peek the dispatched command, current target, and candidates
+        # already tried — everything failover needs to re-dispatch the
+        # SAME peek_id to the next replica. Draining replicas stay
+        # connected (they may still answer what they hold) but are
+        # excluded from new routing decisions.
+        self._inflight_peeks: dict[int, dict] = {}
+        self._draining: set[str] = set()
+        self.routing_stats = {
+            "routed": 0,  # single-target dispatches
+            "broadcast": 0,  # fan-out dispatches (mode or no candidate)
+            "avoided": 0,  # duplicate dispatches routing skipped
+            "failovers": 0,  # re-dispatches (disconnect/stall/drain)
+            "fallback_broadcasts": 0,  # terminal candidate-exhausted
+        }
+        self.routed_counts: dict[str, int] = {}  # replica -> dispatches
         # The RTT-amortized read plane: batches fast-path lookups.
         self._peek_batcher = PeekBatcher(self)
         self._absorber = threading.Thread(
@@ -690,7 +773,12 @@ class ComputeController:
             for per_df in self.arrangement_bytes.values():
                 per_df.pop(name, None)
             self.replica_metrics.pop(name, None)
+            self._draining.discard(name)
+            self.routed_counts.pop(name, None)
         self.hydration.forget_replica(name)
+        # Reads still in flight against the dropped replica re-route
+        # to the survivors (the stopped client can no longer answer).
+        self._on_replica_disconnect(name)
 
     def _history_snapshot(self):
         with self._lock:
@@ -710,6 +798,316 @@ class ComputeController:
             targets = list(self.replicas.values())
         for rc in targets:
             rc.send(cmd)
+
+    # -- read routing (ISSUE 19) ----------------------------------------------
+    def route_candidates(self, dataflow: str) -> list[str]:
+        """Ranked failover chain for reads of ``dataflow``: CONNECTED,
+        non-draining replicas, serving-capable ones first (hydration
+        board hydrated/swapping, or any reported frontier — a replica
+        mid-rehydration must not be preferred over one that answers),
+        then by windowed p50 wallclock lag (no lag data ranks last),
+        ties toward the higher reported frontier, then name order.
+        Element 0 is the routing target; the rest are the failover
+        order."""
+        from .freshness import FRESHNESS
+
+        with self._lock:
+            _lockcheck.shared_read("controller.replicas")
+            live = [
+                r
+                for r, rc in self.replicas.items()
+                if rc.connected.is_set() and r not in self._draining
+            ]
+            per_frontier = dict(self.frontiers.get(dataflow, {}))
+        if not live:
+            return []
+        summary = FRESHNESS.summary()
+
+        def rank(r):
+            s = summary.get((dataflow, r))
+            lag = (
+                s["p50_ms"]
+                if s is not None and s["samples"]
+                else float("inf")
+            )
+            status = self.hydration.status((dataflow, r))
+            serving = (
+                status in ("hydrated", "swapping")
+                or per_frontier.get(r, 0) > 0
+            )
+            return (0 if serving else 1, lag, -per_frontier.get(r, 0), r)
+
+        return sorted(live, key=rank)
+
+    def serving_replicas(self, dataflow: str) -> list[str]:
+        """Connected, non-draining replicas currently ABLE to answer
+        reads of ``dataflow``: hydrated/swapping on the board, or
+        reporting a frontier. The rolling-restart invariant ("at least
+        one hydrated replica serves every durable dataflow at every
+        instant", server/environmentd.py) counts exactly these."""
+        out = []
+        for r in self.route_candidates(dataflow):
+            status = self.hydration.status((dataflow, r))
+            with self._lock:
+                _lockcheck.shared_read("controller.observed")
+                frontier = self.frontiers.get(dataflow, {}).get(r, 0)
+            if status in ("hydrated", "swapping") or frontier > 0:
+                out.append(r)
+        return out
+
+    def routing_target(self, dataflow: str) -> str | None:
+        """Where a read of ``dataflow`` dispatches right now: the head
+        of the candidate chain, or None (broadcast mode / nothing
+        connected). The EXPLAIN ANALYSIS ``replicas:`` block and the
+        subscribe hub's tail attribution read this."""
+        from ..utils.dyncfg import COMPUTE_CONFIGS, PEEK_ROUTING
+
+        if str(PEEK_ROUTING(COMPUTE_CONFIGS)).lower() == "broadcast":
+            return None
+        cands = self.route_candidates(dataflow)
+        return cands[0] if cands else None
+
+    def _dispatch_peek(
+        self, peek_id: int, dataflow: str, cmd: dict
+    ) -> None:
+        """Dispatch a registered peek (its event is already in
+        ``_peek_events``): to ONE routed replica by default, recording
+        enough in ``_inflight_peeks`` to fail over; broadcast when the
+        mode says so or no candidate is connected."""
+        from ..utils.dyncfg import COMPUTE_CONFIGS, PEEK_ROUTING
+
+        target = None
+        if str(PEEK_ROUTING(COMPUTE_CONFIGS)).lower() != "broadcast":
+            cands = self.route_candidates(dataflow)
+            if cands:
+                target = cands[0]
+        avoided = 0
+        with self._lock:
+            _lockcheck.shared_read("controller.replicas")
+            rc = self.replicas.get(target) if target else None
+            if rc is None:
+                target = None
+            _lockcheck.shared_write("controller.peek_events")
+            self._inflight_peeks[peek_id] = {
+                "dataflow": dataflow,
+                "cmd": cmd,
+                "target": target,
+                "tried": [target] if target else [],
+                "broadcasted": target is None,
+            }
+            if target is None:
+                self.routing_stats["broadcast"] += 1
+            else:
+                n_live = sum(
+                    1
+                    for c in self.replicas.values()
+                    if c.connected.is_set()
+                )
+                avoided = max(n_live - 1, 0)
+                self.routing_stats["routed"] += 1
+                self.routing_stats["avoided"] += avoided
+                self.routed_counts[target] = (
+                    self.routed_counts.get(target, 0) + 1
+                )
+        if target is None:
+            self._broadcast(cmd)
+            return
+        routed_peeks_total().inc()
+        if avoided:
+            broadcast_avoided_total().inc(avoided)
+        rc.send(cmd)
+
+    def _failover_peek(self, peek_id: int, reason: str) -> bool:
+        """Re-dispatch a still-unanswered routed peek to the next
+        candidate (or, with the chain exhausted / the attempts cap
+        hit, fall back to ONE broadcast — any surviving replica may
+        answer, first response wins). Returns True when a re-dispatch
+        happened. Safe to race the original answer: the absorber's
+        first-wins check under _lock drops stragglers."""
+        pol = retry_mod.policy("failover")
+        max_hops = pol.attempts if pol.attempts > 0 else 3
+        with self._lock:
+            _lockcheck.shared_write("controller.peek_events")
+            info = self._inflight_peeks.get(peek_id)
+            if (
+                info is None
+                or info["broadcasted"]
+                or peek_id not in self._peek_events
+                or peek_id in self._peek_results
+            ):
+                return False
+            dataflow = info["dataflow"]
+            tried = list(info["tried"])
+        # route_candidates takes _lock itself; choose outside, then
+        # re-validate and commit the choice under the lock.
+        cands = [
+            r
+            for r in self.route_candidates(dataflow)
+            if r not in tried
+        ]
+        with self._lock:
+            _lockcheck.shared_write("controller.peek_events")
+            info = self._inflight_peeks.get(peek_id)
+            if (
+                info is None
+                or info["broadcasted"]
+                or peek_id not in self._peek_events
+                or peek_id in self._peek_results
+            ):
+                return False
+            self.routing_stats["failovers"] += 1
+            if not cands or len(info["tried"]) >= max_hops:
+                info["broadcasted"] = True
+                info["target"] = None
+                self.routing_stats["fallback_broadcasts"] += 1
+                rc = None
+            else:
+                nxt = cands[0]
+                info["target"] = nxt
+                info["tried"].append(nxt)
+                self.routed_counts[nxt] = (
+                    self.routed_counts.get(nxt, 0) + 1
+                )
+                _lockcheck.shared_read("controller.replicas")
+                rc = self.replicas.get(nxt)
+            cmd = info["cmd"]
+        peek_failovers_total().inc()
+        if rc is None:
+            self._broadcast(cmd)
+        else:
+            rc.send(cmd)
+        return True
+
+    def _await_peek_event(
+        self, peek_id: int, ev: threading.Event, timeout: float
+    ) -> bool:
+        """Wait for a peek's response with stall failover: every
+        ``retry_policy_failover`` base interval without an answer,
+        re-dispatch to the next candidate (disconnect failover happens
+        eagerly in the absorber; this timer catches a target that is
+        connected but wedged). Returns the event verdict within the
+        caller's overall ``timeout``."""
+        pol = retry_mod.policy("failover")
+        stall = pol.base if pol.base > 0 else 0.0
+        deadline = _time.monotonic() + timeout
+        while True:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                return ev.is_set()
+            if stall <= 0:
+                return ev.wait(remaining)
+            if ev.wait(min(stall, remaining)):
+                return True
+            if not self._failover_peek(peek_id, "stall"):
+                # Nothing left to fail over to (broadcast already, or
+                # chain exhausted): plain-wait the rest of the budget.
+                stall = 0.0
+
+    def _cancel_peek(self, peek_id: int, info: dict | None) -> None:
+        """Post-resolution cleanup dispatch: cancel on the replicas
+        that actually saw the peek (the routed `tried` chain), or all
+        of them after a broadcast."""
+        cmd = ctp.cancel_peek(peek_id)
+        if info is None or info.get("broadcasted") or not info.get(
+            "tried"
+        ):
+            self._broadcast(cmd)
+            return
+        with self._lock:
+            _lockcheck.shared_read("controller.replicas")
+            targets = [
+                self.replicas[r]
+                for r in info["tried"]
+                if r in self.replicas
+            ]
+        for rc in targets:
+            rc.send(cmd)
+
+    def _on_replica_disconnect(self, name: str) -> None:
+        """A replica's session died: every in-flight routed read
+        targeting it re-dispatches to the next candidate NOW — waiters
+        must not ride out the stall timer (ISSUE 19 satellite: the
+        disconnect event, not the timeout, is the failover trigger)."""
+        with self._lock:
+            _lockcheck.shared_read("controller.peek_events")
+            doomed = [
+                pid
+                for pid, info in self._inflight_peeks.items()
+                if info["target"] == name
+            ]
+        for pid in doomed:
+            self._failover_peek(pid, "disconnect")
+
+    def drain_replica(
+        self, name: str, timeout: float | None = None
+    ) -> dict:
+        """Graceful removal: stop routing NEW reads to ``name``,
+        immediately move its in-flight routed reads to surviving
+        candidates, wait (failover budget) for stragglers, then
+        drop_replica. The replica stays connected while draining so
+        already-dispatched work it holds can still answer."""
+        pol = retry_mod.policy("failover")
+        if timeout is None:
+            timeout = pol.budget if pol.budget > 0 else 10.0
+        with self._lock:
+            _lockcheck.shared_read("controller.replicas")
+            known = name in self.replicas
+            if known:
+                self._draining.add(name)
+        if not known:
+            return {"drained": False, "moved": 0}
+        with self._lock:
+            _lockcheck.shared_read("controller.peek_events")
+            pids = [
+                pid
+                for pid, info in self._inflight_peeks.items()
+                if info["target"] == name
+            ]
+        moved = sum(
+            1 for pid in pids if self._failover_peek(pid, "drain")
+        )
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            with self._lock:
+                _lockcheck.shared_read("controller.peek_events")
+                still = any(
+                    info["target"] == name
+                    for info in self._inflight_peeks.values()
+                )
+            if not still:
+                break
+            _time.sleep(0.01)
+        self.drop_replica(name)
+        return {"drained": True, "moved": moved}
+
+    def replica_states(self) -> list[dict]:
+        """The mz_cluster_replicas rows' source: per replica its
+        connection state, lifecycle state (active|draining), and how
+        many reads routed to it."""
+        with self._lock:
+            _lockcheck.shared_read("controller.replicas")
+            items = sorted(self.replicas.items())
+            draining = set(self._draining)
+            routed = dict(self.routed_counts)
+        return [
+            {
+                "name": n,
+                "connected": rc.connected.is_set(),
+                "state": "draining" if n in draining else "active",
+                "routed": routed.get(n, 0),
+            }
+            for n, rc in items
+        ]
+
+    def routing_snapshot(self) -> dict:
+        """Routing observability (bench.py --serve's per-replica
+        distribution + the mz_metrics counters' in-process twin)."""
+        with self._lock:
+            out = dict(self.routing_stats)
+            out["per_replica"] = dict(self.routed_counts)
+            out["draining"] = sorted(self._draining)
+            out["inflight"] = len(self._inflight_peeks)
+        return out
 
     # -- commands -------------------------------------------------------------
     def create_dataflow(self, desc: DataflowDescription) -> None:
@@ -829,8 +1227,10 @@ class ComputeController:
         self, dataflow: str, as_of: int | None, timeout: float = 30.0,
         exact: bool = False,
     ):
-        """Peek on every replica; first response wins
-        (absorb_peek_response). Returns (rows, served_at)."""
+        """Peek, ROUTED to the least-lagged hydrated replica (with
+        disconnect/stall failover) by default; broadcast to every
+        replica with first-response-wins under
+        peek_routing='broadcast'. Returns (rows, served_at)."""
         from ..utils.trace import TRACER
 
         peek_id = next(self._peek_counter)
@@ -844,14 +1244,16 @@ class ComputeController:
         with TRACER.span(
             "controller.peek", dataflow=dataflow, peek_id=peek_id
         ):
-            self._broadcast(
+            self._dispatch_peek(
+                peek_id,
+                dataflow,
                 ctp.peek(
                     peek_id, dataflow, as_of, exact,
                     trace=TRACER.context(),
-                )
+                ),
             )
             try:
-                if not ev.wait(timeout):
+                if not self._await_peek_event(peek_id, ev, timeout):
                     # Retryable by contract (ISSUE 10 satellite): the
                     # front ends shed this as ServerBusy (53400 / 503),
                     # and the sequencing lock was released around the
@@ -869,12 +1271,13 @@ class ComputeController:
             finally:
                 # Event first, then any straggler result, both under
                 # the absorber's lock: later duplicate responses cannot
-                # leak.
+                # leak. Cancels go to the replicas that saw the peek.
                 with self._lock:
                     _lockcheck.shared_write("controller.peek_events")
                     self._peek_events.pop(peek_id, None)
                     self._peek_results.pop(peek_id, None)
-                self._broadcast(ctp.cancel_peek(peek_id))
+                    info = self._inflight_peeks.pop(peek_id, None)
+                self._cancel_peek(peek_id, info)
 
     def peek_lookup(
         self,
@@ -900,8 +1303,11 @@ class ComputeController:
 
     def peek_stats(self) -> dict:
         """Read-plane observability: lookups, batches, occupancy,
-        shed count, queue depth (bench.py --serve reports these)."""
-        return self._peek_batcher.snapshot()
+        shed count, queue depth, and the routing distribution
+        (bench.py --serve reports these)."""
+        out = self._peek_batcher.snapshot()
+        out["routing"] = self.routing_snapshot()
+        return out
 
     # -- response absorption ---------------------------------------------------
     def _absorb_responses(self) -> None:
@@ -1011,6 +1417,8 @@ class ComputeController:
                     if ev is not None and pid not in self._peek_results:
                         self._peek_results[pid] = msg  # first wins
                         ev.set()
+            elif kind == "ReplicaDisconnected":
+                self._on_replica_disconnect(msg["__replica__"])
 
     # -- observed state --------------------------------------------------------
     def frontier(self, dataflow: str) -> int:

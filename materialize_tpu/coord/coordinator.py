@@ -484,6 +484,60 @@ class Coordinator:
                 lines.append(line)
         return "\n".join(lines)
 
+    def _replicas_analysis_text(self) -> str:
+        """The elastic read plane's EXPLAIN ANALYSIS block (ISSUE 19):
+        per installed catalog-named dataflow, the replica set with
+        hydration status + windowed lag and the CURRENT routing
+        target — routing decisions inspectable without reading
+        metrics. Same scoping discipline as the freshness block
+        (transients excluded; mz_cluster_replicas serves the replica
+        rows relationally)."""
+        from .freshness import FRESHNESS
+
+        named = {it.name for it in self.catalog.items.values()}
+        named |= set(self.peekable.values())
+        with self.controller._lock:
+            installed = sorted(
+                n for n in self.controller._dataflows if n in named
+            )
+        states = {
+            s["name"]: s for s in self.controller.replica_states()
+        }
+        lines = ["replicas:"]
+        if not installed:
+            lines.append("  (no dataflows installed)")
+            return "\n".join(lines)
+        summary = FRESHNESS.summary()
+        for df in installed:
+            target = self.controller.routing_target(df)
+            cands = self.controller.route_candidates(df)
+            parts = []
+            for rep in sorted(states):
+                st = states[rep]
+                status = (
+                    self.controller.hydration.status((df, rep))
+                    or "pending"
+                )
+                piece = f"{rep}:{status}"
+                if st["state"] == "draining":
+                    piece += "(draining)"
+                elif not st["connected"]:
+                    piece += "(disconnected)"
+                s = summary.get((df, rep))
+                if s is not None and s["samples"]:
+                    piece += f" lag_p50_ms={s['p50_ms']:.1f}"
+                parts.append(piece)
+            line = f"  {df}: [" + ", ".join(parts) + "]"
+            line += (
+                f" target={target}"
+                if target is not None
+                else " target=broadcast"
+            )
+            if len(cands) > 1:
+                line += " failover=[" + ", ".join(cands[1:]) + "]"
+            lines.append(line)
+        return "\n".join(lines)
+
     def health(self) -> dict:
         """The /api/readyz verdict (the freshness plane's probe,
         ISSUE 15): ready iff catalog replay had no failures AND (no
@@ -838,6 +892,8 @@ class Coordinator:
                     + self.subscribe_hub.analysis_text()
                     + "\n"
                     + self._freshness_analysis_text()
+                    + "\n"
+                    + self._replicas_analysis_text()
                 )
             return ExecuteResult(
                 "text", text=text, columns=("explain",)

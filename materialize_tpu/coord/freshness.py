@@ -353,6 +353,15 @@ class FreshnessRecorder:
             _lockcheck.shared_read("freshness.lag_rings")
             return list(self._events)
 
+    def breaching(self) -> set:
+        """The (dataflow, replica) keys currently IN breach — past
+        onset, not yet recovered. The autoscaler's scale-up signal
+        (coord/autoscaler.py): a sustained non-empty set means the
+        deployment is not keeping its freshness_slo_ms."""
+        with self._lock:
+            _lockcheck.shared_read("freshness.lag_rings")
+            return set(self._in_breach)
+
     def forget(self, dataflow: str) -> None:
         """Drop per-key state for a dropped dataflow (the bounded
         history ring ages its records out naturally)."""

@@ -165,7 +165,29 @@ INTROSPECTION_SCHEMAS: dict[str, Schema] = {
         ]
     ),
     "mz_cluster_replicas": Schema(
-        [Column("name", S), Column("connected", I)]
+        [
+            Column("name", S),
+            Column("connected", I),
+            # Lifecycle state (ISSUE 19): active | draining (a
+            # draining replica stays connected but takes no new
+            # routed reads).
+            Column("state", S),
+            # Reads routed to this replica (the per-replica routing
+            # distribution bench.py --serve reports).
+            Column("routed", I),
+        ]
+    ),
+    # Every autoscaler decision with its triggering evidence
+    # (coord/autoscaler.py ledger, ISSUE 19): why each replica was
+    # spawned or drained, explainable after the fact.
+    "mz_autoscale_events": Schema(
+        [
+            Column("at", F),
+            Column("action", S),
+            Column("replica", S),
+            Column("reason", S),
+            Column("evidence", S),
+        ]
     ),
     # -- the freshness plane (ISSUE 15) -----------------------------------
     "mz_wallclock_lag_history": Schema(
@@ -571,8 +593,27 @@ def snapshot(coord, name: str) -> list[tuple]:
         ]
     if name == "mz_cluster_replicas":
         return [
-            (_enc(n), int(rc.connected.is_set()))
-            for n, rc in sorted(coord.controller.replicas.items())
+            (
+                _enc(s["name"]),
+                int(s["connected"]),
+                _enc(s["state"]),
+                int(s["routed"]),
+            )
+            for s in coord.controller.replica_states()
+        ]
+    if name == "mz_autoscale_events":
+        from .autoscaler import AUTOSCALE
+
+        return [
+            (
+                float(at),
+                _enc(action),
+                _enc(replica),
+                _enc(reason),
+                _enc(evidence),
+            )
+            for at, action, replica, reason, evidence
+            in AUTOSCALE.rows()
         ]
     if name == "mz_wallclock_lag_history":
         from .freshness import FRESHNESS

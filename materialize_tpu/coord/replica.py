@@ -1319,11 +1319,18 @@ def serve_forever(
     ready_event: threading.Event | None = None,
     workers: int = 1,
     ship_observability: bool = False,
+    handle: list | None = None,
 ) -> None:
     worker = ReplicaWorker(
         location=location, replica_id=replica_id, workers=workers,
         ship_observability=ship_observability,
     )
+    if handle is not None:
+        # In-process lifecycle hook (ISSUE 19): the caller gets the
+        # worker so drop/rolling-restart can stop a thread replica the
+        # way SIGTERM stops a subprocess one (worker.stop() exits
+        # serve() within its 0.2s accept timeout).
+        handle.append(worker)
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     sock.bind(("127.0.0.1", port))

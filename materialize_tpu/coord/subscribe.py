@@ -360,6 +360,16 @@ class _SharedTail:
         self.spans = 0  # span windows consumed
         self.snapshot_reads = 0  # join/coalesce state reads (per event,
         # not per span — excluded from readbacks_per_span)
+        # Routed-replica attribution: the tail reads the durable sink
+        # shard directly (no replica in the read path), but the shard
+        # only advances because SOME replica maintains the dataflow —
+        # record which replica the controller currently routes to for
+        # this dataflow, so mz_subscriptions / chaos runs can attribute
+        # push-plane delivery to the effective producer and count
+        # failovers (route_changes) across replica kills.
+        self.routed: str | None = None
+        self.route_changes = 0
+        self._route_checked = 0.0
         self.retired = False
         self._lock = tracked_lock("subscribe.tail")
         self._stop = threading.Event()
@@ -381,6 +391,7 @@ class _SharedTail:
         )
 
         while not self._stop.is_set():
+            self._refresh_route()
             timeout = max(
                 float(SUBSCRIBE_TAIL_POLL_MS(COMPUTE_CONFIGS)) / 1000.0,
                 0.005,
@@ -423,6 +434,27 @@ class _SharedTail:
             # (its queued error still surfaces if it ever returns).
             for s in doomed:
                 self.hub.close_session(s)
+
+    def _refresh_route(self) -> None:
+        """Throttled (~1s) routed-replica attribution sample; a change
+        from one live replica to another is counted as a route change
+        (the push-plane failover witness the chaos storm asserts on)."""
+        now = _time.monotonic()
+        if now - self._route_checked < 1.0:
+            return
+        self._route_checked = now
+        df = self.owned_dataflow or self.label
+        if not df:
+            return
+        try:
+            target = self.hub.coord.controller.routing_target(df)
+        except Exception:
+            return
+        with self._lock:
+            if target != self.routed:
+                if self.routed is not None and target is not None:
+                    self.route_changes += 1
+                self.routed = target
 
     # -- membership ---------------------------------------------------------
     def add_session(
@@ -530,6 +562,8 @@ class _SharedTail:
                 "readbacks": self.readbacks,
                 "spans": self.spans,
                 "snapshot_reads": self.snapshot_reads,
+                "routed": self.routed,
+                "route_changes": self.route_changes,
             }
 
     def retire(self) -> None:
@@ -929,7 +963,9 @@ class SubscribeHub:
                 f"owned={str(bool(t['owned'])).lower()} "
                 f"frontier={t['frontier']} "
                 f"readbacks={t['readbacks']} spans={t['spans']} "
-                f"readbacks_per_span={rps:.2f}"
+                f"readbacks_per_span={rps:.2f} "
+                f"routed={t['routed'] or 'none'} "
+                f"route_changes={t['route_changes']}"
             )
         lines.append(
             f"  totals: sessions={snap['sessions']} "

@@ -84,43 +84,22 @@ class Environment:
         # MZ_PROGRAM_BANK in spawn_replica) — shares one bank under
         # the blob root. Recovery's re-renders become bank hits.
         from ..compile.bank import configure_bank
+        from ..utils.lockcheck import tracked_lock
 
         configure_bank(bank_path(data_dir))
         self.procs: list[subprocess.Popen] = []
-        self._threads = []
-        replica_ports = []
+        self._in_process = in_process_replicas
+        self._default_workers = workers
+        # Replica registry (ISSUE 19): rid -> {port, proc|worker+thread,
+        # workers}. add/drop/rolling-restart/autoscale actions all
+        # serialize on the scale lock — the interleave model
+        # `autoscale-vs-restart` pins why (an unserialized check-then-
+        # spawn can bust the replica band or drop the last server).
+        self.replica_records: dict[str, dict] = {}
+        self._scale_lock = tracked_lock("environment.scale")
+        self._replica_seq = n_replicas
         for i in range(n_replicas):
-            port = _free_port()
-            rid = f"r{i}"
-            if in_process_replicas:
-                import threading
-
-                from ..coord.protocol import PersistLocation
-                from ..coord.replica import serve_forever
-
-                ready = threading.Event()
-                t = threading.Thread(
-                    target=serve_forever,
-                    args=(
-                        port,
-                        PersistLocation(
-                            os.path.join(data_dir, "blob"),
-                            os.path.join(data_dir, "consensus.db"),
-                        ),
-                        rid,
-                        ready,
-                    ),
-                    kwargs={"workers": workers},
-                    daemon=True,
-                )
-                t.start()
-                ready.wait(10)
-                self._threads.append(t)
-            else:
-                self.procs.append(
-                    spawn_replica(data_dir, port, rid, workers)
-                )
-            replica_ports.append((rid, port))
+            self._spawn_record(f"r{i}", workers=workers)
         self.coord = Coordinator(
             PersistClient(
                 FileBlob(os.path.join(data_dir, "blob")),
@@ -128,11 +107,249 @@ class Environment:
             ),
             tick_interval=tick_interval,
         )
-        for rid, port in replica_ports:
-            self.coord.add_replica(rid, ("127.0.0.1", port))
+        for rid, rec in self.replica_records.items():
+            self.coord.add_replica(rid, ("127.0.0.1", rec["port"]))
         self.pg = PgServer(self.coord, port=pg_port).start()
         self.http = HttpServer(self.coord, port=http_port).start()
         self._down = False
+        # The SLO-driven autoscaler (coord/autoscaler.py): the policy
+        # thread always runs; it acts only while the autoscale_policy
+        # dyncfg is non-empty, so SET enables/disables it live.
+        from ..coord.autoscaler import Autoscaler
+
+        self.autoscaler = Autoscaler(
+            self.coord.controller,
+            lambda: self.add_replica(),
+            lambda rid: self.drop_replica(rid, drain=True),
+        ).start()
+
+    # -- replica lifecycle (ISSUE 19) ---------------------------------------
+    def _spawn_record(
+        self, rid: str, workers: int | None = None
+    ) -> dict:
+        """Start one replica (subprocess or in-process thread, matching
+        the deployment mode) and register it in the records map. Does
+        NOT touch the coordinator — callers pair this with
+        coord.add_replica under the scale lock."""
+        port = _free_port()
+        w = self._default_workers if workers is None else workers
+        if self._in_process:
+            import threading
+
+            from ..coord.protocol import PersistLocation
+            from ..coord.replica import serve_forever
+
+            ready = threading.Event()
+            handle: list = []
+            t = threading.Thread(
+                target=serve_forever,
+                args=(
+                    port,
+                    PersistLocation(
+                        os.path.join(self.data_dir, "blob"),
+                        os.path.join(self.data_dir, "consensus.db"),
+                    ),
+                    rid,
+                    ready,
+                ),
+                kwargs={"workers": w, "handle": handle},
+                daemon=True,
+            )
+            t.start()
+            ready.wait(10)
+            rec = {
+                "port": port,
+                "proc": None,
+                "worker": handle[0] if handle else None,
+                "thread": t,
+                "workers": w,
+            }
+        else:
+            p = spawn_replica(self.data_dir, port, rid, w)
+            self.procs.append(p)
+            rec = {
+                "port": port, "proc": p, "worker": None,
+                "thread": None, "workers": w,
+            }
+        self.replica_records[rid] = rec
+        return rec
+
+    def _stop_record(self, rec: dict) -> None:
+        p = rec.get("proc")
+        if p is not None:
+            from ..utils.retry import policy as _retry_policy
+
+            budget = _retry_policy("shutdown").budget or 5.0
+            p.terminate()
+            try:
+                p.wait(timeout=budget)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+            if p in self.procs:
+                self.procs.remove(p)
+        w = rec.get("worker")
+        if w is not None:
+            w.stop()
+            t = rec.get("thread")
+            if t is not None:
+                t.join(2)
+
+    def add_replica(
+        self, rid: str | None = None, workers: int | None = None
+    ) -> str:
+        """Runtime scale-up (`CREATE CLUSTER REPLICA` analog): spawn,
+        register with the controller (the nonce Hello fences it like
+        any boot-time replica), and return the name. It hydrates from
+        the shared program bank, so join time is seconds — it becomes
+        a routing candidate once the hydration board flips."""
+        with self._scale_lock:
+            if self._down:
+                raise RuntimeError("environment is shut down")
+            if rid is None:
+                rid = f"r{self._replica_seq}"
+                self._replica_seq += 1
+            if rid in self.replica_records:
+                raise ValueError(f"replica {rid!r} already exists")
+            rec = self._spawn_record(rid, workers=workers)
+            self.coord.add_replica(rid, ("127.0.0.1", rec["port"]))
+        return rid
+
+    def drop_replica(self, rid: str, drain: bool = True) -> dict:
+        """Runtime scale-down (`DROP CLUSTER REPLICA` analog): drain
+        (stop routing, move in-flight reads, then drop) or hard-drop,
+        then stop the process/thread."""
+        with self._scale_lock:
+            return self._drop_replica_locked(rid, drain)
+
+    def _drop_replica_locked(self, rid: str, drain: bool) -> dict:
+        rec = self.replica_records.pop(rid, None)
+        if rec is None:
+            return {"dropped": False, "reason": "unknown replica"}
+        ctl = self.coord.controller
+        if drain:
+            out = dict(ctl.drain_replica(rid))
+        else:
+            ctl.drop_replica(rid)
+            out = {"drained": False}
+        self._stop_record(rec)
+        out["dropped"] = True
+        return out
+
+    def rolling_restart(
+        self, hydrate_timeout: float = 60.0
+    ) -> dict:
+        """Restart every replica, one at a time, under live ingest +
+        serving. Per replica: wait until every durable dataflow has at
+        least one OTHER serving replica, drain it (in-flight reads
+        move immediately), stop it, respawn the SAME rid (fenced
+        Hello, warm program bank -> seconds-scale rehydration), and
+        wait until it serves again before touching the next one.
+
+        The "at least one hydrated replica serves every durable
+        dataflow at every instant" invariant is CHECKED, not assumed:
+        a monitor thread samples `controller.serving_replicas` for
+        every durable dataflow throughout and the report carries every
+        violation (none = the restart was continuously served).
+        `rebuilds` counts the restarted replicas' reported dataflow
+        rebuilds — 0 on unchanged fingerprints (reconciliation +
+        program bank)."""
+        import threading
+        import time as _t
+
+        ctl = self.coord.controller
+        dataflows = sorted(set(self.coord.peekable.values()))
+        monitor_stop = threading.Event()
+        violations: list = []
+        samples = [0]
+
+        def monitor():
+            while not monitor_stop.is_set():
+                samples[0] += 1
+                for df in dataflows:
+                    if not ctl.serving_replicas(df):
+                        violations.append((df, samples[0]))
+                monitor_stop.wait(0.02)
+
+        mt = threading.Thread(target=monitor, daemon=True)
+        mt.start()
+        report: dict = {"replicas": [], "aborted": None}
+        try:
+            for rid in list(self.replica_records):
+                with self._scale_lock:
+                    if rid not in self.replica_records:
+                        continue  # dropped while we iterated
+                    entry: dict = {"replica": rid}
+                    t0 = _t.monotonic()
+                    deadline = t0 + hydrate_timeout
+                    # Precondition: losing `rid` must leave every
+                    # durable dataflow served by someone else.
+                    uncovered = dataflows
+                    while _t.monotonic() < deadline:
+                        uncovered = [
+                            df
+                            for df in dataflows
+                            if not [
+                                r
+                                for r in ctl.serving_replicas(df)
+                                if r != rid
+                            ]
+                        ]
+                        if not uncovered:
+                            break
+                        _t.sleep(0.05)
+                    if uncovered:
+                        entry["error"] = (
+                            "no other serving replica for "
+                            f"{uncovered}; restart aborted"
+                        )
+                        report["replicas"].append(entry)
+                        report["aborted"] = rid
+                        break
+                    workers = self.replica_records[rid]["workers"]
+                    drained = self._drop_replica_locked(
+                        rid, drain=True
+                    )
+                    entry["moved_reads"] = drained.get("moved", 0)
+                    rec = self._spawn_record(rid, workers=workers)
+                    self.coord.add_replica(
+                        rid, ("127.0.0.1", rec["port"])
+                    )
+                    while _t.monotonic() < deadline:
+                        if all(
+                            rid in ctl.serving_replicas(df)
+                            for df in dataflows
+                        ):
+                            break
+                        _t.sleep(0.05)
+                    entry["seconds"] = round(_t.monotonic() - t0, 3)
+                    entry["rehydrated"] = all(
+                        rid in ctl.serving_replicas(df)
+                        for df in dataflows
+                    )
+                report["replicas"].append(entry)
+        finally:
+            monitor_stop.set()
+            mt.join(2)
+        # The restarted replicas' own rebuild counts (piggybacked on
+        # their frontier reports): 0 on unchanged fingerprints.
+        restarted = {e["replica"] for e in report["replicas"]}
+        rebuilds = 0
+        snap = ctl.recovery_snapshot()["dataflows"]
+        for df, per in snap.items():
+            for rep, counters in per.items():
+                if rep in restarted:
+                    rebuilds += int(counters.get("rebuilds", 0))
+        report["rebuilds"] = rebuilds
+        report["invariant"] = {
+            "samples": samples[0],
+            "violations": violations[:20],
+            "continuously_served": not violations,
+        }
+        return report
 
     # -- restart recovery (ISSUE 10) ----------------------------------------
     def recovery_report(self) -> dict:
@@ -209,6 +426,13 @@ class Environment:
         if self._down:
             return report
         self._down = True
+        self.autoscaler.stop()
+        # In-process thread replicas stop via their worker handle (the
+        # subprocess ones get the terminate -> kill loop below).
+        for rec in self.replica_records.values():
+            w = rec.get("worker")
+            if w is not None:
+                w.stop()
         # Un-configure the process-global bank: the deployment owns
         # its bank directory; a later Environment (or a bankless
         # caller in the same process, e.g. the test suite) must not

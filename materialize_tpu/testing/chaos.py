@@ -1070,6 +1070,441 @@ def run_subscriber_storm(
     return rep
 
 
+# ---------------------------------------------------------------------------
+# the failover storm (ISSUE 19): routed reads under replica SIGKILL
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FailoverStormReport:
+    """Elastic-serving chaos outcome: N replicas serve routed reads
+    while ingest churns; the routed-to replica is killed MID-PEEK
+    (paced: the kill waits until a read is registered in flight
+    against it) and every client-visible result must still equal the
+    host-side oracle exactly — the failover re-dispatch plus the
+    first-response-wins dedup make a duplicate or a lost waiter
+    impossible, and this report counts both."""
+
+    replicas: int = 0
+    ticks: int = 0
+    kills: int = 0
+    killed: list = field(default_factory=list)
+    routed_before: str | None = None
+    routed_after: str | None = None
+    failovers: int = 0
+    routed_peeks: int = 0
+    fallback_broadcasts: int = 0
+    retried_statements: int = 0
+    reader_queries: int = 0
+    route_changes: int = 0
+    failures: list = field(default_factory=list)
+    oracle: dict = field(default_factory=dict)
+    result: dict = field(default_factory=dict)
+    subscribe: dict = field(default_factory=dict)
+    inflight_rows: int = -1
+    elapsed_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+def run_failover_storm(
+    data_dir: str,
+    seed: int = 0,
+    ticks: int = 20,
+    replicas: int = 3,
+    subprocess_replicas: bool = True,
+    verify_timeout: float = 180.0,
+) -> FailoverStormReport:
+    """Drive a coordinator + N replicas with routed reads under
+    insert/retraction churn, SIGKILL the replica the controller is
+    routing to while a peek is IN FLIGHT against it, and verify:
+
+    1. the in-flight peek resolves through failover with the EXACT
+       rows its as_of implies (no lost waiter, no duplicate rows —
+       a double-delivered response would double the multiset);
+    2. every storm statement succeeds with at most one retried
+       statement total (zero client-visible errors otherwise);
+    3. the final peeked result and a SUBSCRIBE session's reconstructed
+       state both equal the oracle multiset exactly;
+    4. the routing target after the kill is a surviving replica.
+
+    ``subprocess_replicas=False`` runs in-process workers (kill =
+    ``worker.stop()``, which hard-closes the live session — the same
+    disconnect edge, minus the SIGKILL) so the smoke gate can run
+    where fork is unavailable.
+    """
+    from ..coord.coordinator import Coordinator
+    from ..coord.protocol import PersistLocation
+    from ..coord.replica import serve_forever
+    from ..storage.persist import (
+        FileBlob,
+        PersistClient,
+        SqliteConsensus,
+    )
+
+    t0 = _time.monotonic()
+    rng = random.Random(seed ^ 0xFA170)
+    os.makedirs(data_dir, exist_ok=True)
+    blob_path = os.path.join(data_dir, "blob")
+    cons_path = os.path.join(data_dir, "consensus.db")
+    rep = FailoverStormReport(replicas=replicas, ticks=ticks)
+
+    records: dict[str, dict] = {}
+    for i in range(replicas):
+        rid = f"r{i}"
+        port = _free_port()
+        if subprocess_replicas:
+            records[rid] = {
+                "port": port,
+                "proc": ReplicaProcess(
+                    blob_path, cons_path, port, rid=rid
+                ),
+                "worker": None,
+            }
+        else:
+            handle: list = []
+            ready = threading.Event()
+            threading.Thread(
+                target=serve_forever,
+                args=(
+                    port,
+                    PersistLocation(blob_path, cons_path),
+                    rid,
+                    ready,
+                ),
+                kwargs={"handle": handle},
+                daemon=True,
+            ).start()
+            ready.wait(10)
+            records[rid] = {
+                "port": port,
+                "proc": None,
+                "worker": handle[0] if handle else None,
+            }
+
+    coord = Coordinator(
+        PersistClient(FileBlob(blob_path), SqliteConsensus(cons_path)),
+        tick_interval=None,
+    )
+    for rid, rec in records.items():
+        coord.add_replica(rid, ("127.0.0.1", rec["port"]))
+    ctl = coord.controller
+
+    def _kill(rid: str) -> None:
+        rec = records[rid]
+        if rec["proc"] is not None:
+            rec["proc"].sigkill()
+        elif rec["worker"] is not None:
+            rec["worker"].stop()
+        rep.killed.append(rid)
+        rep.kills += 1
+
+    oracle: dict = {}
+
+    def expect_sums(state: dict) -> dict:
+        sums: dict = {}
+        for (k, v), n in state.items():
+            sums[k] = sums.get(k, 0) + v * n
+        return {(k, s): 1 for k, s in sums.items()}
+
+    def ex(sql: str):
+        try:
+            return coord.execute(sql)
+        except Exception:
+            # The acceptance budget: at most ONE retried statement
+            # across the whole storm; a second failure is terminal.
+            rep.retried_statements += 1
+            try:
+                return coord.execute(sql)
+            except Exception as e2:
+                rep.failures.append(
+                    f"statement failed after retry: {sql!r}: {e2!r}"
+                )
+                raise
+
+    reader_stop = threading.Event()
+
+    def reader():
+        # Continuous routed reads so the kill lands against a serving
+        # surface, not an idle one. Any error here (beyond the shared
+        # single-retry budget) is a client-visible failover leak.
+        retried = False
+        while not reader_stop.is_set():
+            try:
+                coord.execute("SELECT k, s FROM sums ORDER BY k")
+                rep.reader_queries += 1
+            except Exception as e:
+                if not retried and rep.retried_statements == 0:
+                    retried = True
+                    rep.retried_statements += 1
+                    continue
+                rep.failures.append(
+                    f"reader query failed mid-storm: {e!r}"
+                )
+                return
+
+    sub = None
+    pending: dict = {}
+    pending_thread = None
+    try:
+        coord.execute(
+            "CREATE TABLE kv (k bigint NOT NULL, v bigint NOT NULL)"
+        )
+        coord.execute(
+            "CREATE MATERIALIZED VIEW sums AS "
+            "SELECT k, sum(v) AS s FROM kv GROUP BY k"
+        )
+        sub = coord.execute("SUBSCRIBE sums").subscription
+
+        # Per-statement oracle history: (upper after the statement,
+        # SUM-per-key state). Peeks are served from the replica's
+        # CURRENT consolidated arrangement once its frontier passes
+        # the as_of, so a correct result equals the oracle after SOME
+        # statement prefix at/beyond the pinned frontier — a lost or
+        # double-applied delta produces a state matching NO prefix.
+        history: list = []
+
+        def record() -> None:
+            history.append(
+                (coord._table_writers["kv"].upper, expect_sums(oracle))
+            )
+
+        def feed(t: int) -> None:
+            ups = []
+            for _ in range(rng.randrange(1, 4)):
+                k, v = rng.randrange(6), rng.randrange(100)
+                ups.append((k, v))
+            ex(
+                "INSERT INTO kv VALUES "
+                + ", ".join(f"({k}, {v})" for k, v in ups)
+            )
+            for k, v in ups:
+                oracle[(k, v)] = oracle.get((k, v), 0) + 1
+            record()
+            live = [p for p, n in oracle.items() if n]
+            if live and rng.random() < 0.5:
+                rk, rv = rng.choice(live)
+                n = oracle.pop((rk, rv))
+                if n:
+                    ex(f"DELETE FROM kv WHERE k = {rk} AND v = {rv}")
+                record()
+
+        # Warm-up: every replica hydrates `sums` before the storm so
+        # the kill proves failover, not cold-start racing.
+        feed(0)
+        deadline = _time.monotonic() + 120.0
+        while len(ctl.serving_replicas("sums")) < replicas:
+            if _time.monotonic() > deadline:
+                rep.failures.append(
+                    "not all replicas became serving candidates: "
+                    f"{ctl.serving_replicas('sums')}"
+                )
+                return rep
+            _time.sleep(0.02)
+
+        rt = threading.Thread(target=reader, daemon=True)
+        rt.start()
+        kill_tick = max(2, ticks // 2)
+        for t in range(1, ticks):
+            feed(t)
+            if t == kill_tick:
+                # Pin a peek in flight against the routed target: an
+                # as_of beyond the current frontier parks the response
+                # replica-side, so the SIGKILL provably lands mid-peek
+                # and resolution MUST travel through failover.
+                pending["ts"] = coord._table_writers["kv"].upper + 3
+
+                def pending_peek():
+                    try:
+                        rows, _ = ctl.peek(
+                            "sums", as_of=pending["ts"], timeout=90.0
+                        )
+                        pending["rows"] = rows
+                    except Exception as e:
+                        pending["error"] = repr(e)
+
+                pending_thread = threading.Thread(
+                    target=pending_peek, daemon=True
+                )
+                pending_thread.start()
+                victim = None
+                spin = _time.monotonic() + 10.0
+                while victim is None and _time.monotonic() < spin:
+                    with ctl._lock:
+                        for info in ctl._inflight_peeks.values():
+                            if info["dataflow"] == "sums":
+                                victim = info["target"]
+                                break
+                    if victim is None:
+                        _time.sleep(0.001)
+                if victim is None:
+                    rep.failures.append(
+                        "pinned peek never registered in flight"
+                    )
+                    return rep
+                rep.routed_before = victim
+                _kill(victim)
+        if pending_thread is not None:
+            # Make sure a write crossed the pinned frontier so the
+            # parked peek resolves.
+            while coord._table_writers["kv"].upper <= pending["ts"]:
+                feed(ticks)
+        reader_stop.set()
+        rt.join(30)
+
+        # -- verification ---------------------------------------------------
+        if pending_thread is not None:
+            pending_thread.join(verify_timeout)
+            if pending_thread.is_alive():
+                rep.failures.append(
+                    "in-flight peek never resolved through failover"
+                )
+            elif "error" in pending:
+                rep.failures.append(
+                    f"in-flight peek surfaced an error instead of "
+                    f"failing over: {pending['error']}"
+                )
+            else:
+                got: dict = {}
+                for r in pending.get("rows", []):
+                    got[r[:-2]] = got.get(r[:-2], 0) + r[-1]
+                got = {k: n for k, n in got.items() if n}
+                rep.inflight_rows = len(got)
+                valid = [
+                    snap
+                    for up, snap in history
+                    if up > pending["ts"]
+                ]
+                if got not in valid:
+                    rep.failures.append(
+                        "in-flight peek matches NO oracle prefix at/"
+                        "beyond its as_of (lost waiter or double-"
+                        f"delivered response): {got} not in "
+                        f"{len(valid)} candidate states"
+                    )
+        expect = expect_sums(oracle)
+        rep.oracle = expect
+        try:
+            rows = ex("SELECT k, s FROM sums ORDER BY k").rows
+        except Exception:
+            return rep
+        got = {}
+        for r in rows:
+            got[tuple(r)] = got.get(tuple(r), 0) + 1
+        rep.result = got
+        if got != expect:
+            rep.failures.append(
+                f"final peek diverged from oracle: {got} != {expect}"
+            )
+        rep.routed_after = ctl.routing_target("sums")
+        if rep.routed_after in rep.killed:
+            rep.failures.append(
+                f"routing target {rep.routed_after!r} is a killed "
+                "replica"
+            )
+        snap = ctl.routing_snapshot()
+        rep.failovers = snap["failovers"]
+        rep.routed_peeks = snap["routed"]
+        rep.fallback_broadcasts = snap["fallback_broadcasts"]
+        if rep.kills and not rep.failovers:
+            rep.failures.append(
+                "routed replica killed mid-peek but zero failovers "
+                "recorded"
+            )
+        # SUBSCRIBE exactness: the push plane rides span-fenced sink
+        # writes, so the reconstructed state must equal the oracle —
+        # a double-applied span would overshoot and never converge.
+        final = coord._table_writers["kv"].upper
+        state: dict = {}
+        deadline = _time.monotonic() + verify_timeout
+        while sub.frontier < final:
+            if _time.monotonic() > deadline:
+                rep.failures.append(
+                    f"subscription stuck at frontier {sub.frontier} "
+                    f"< {final}"
+                )
+                break
+            if not sub.wait(1.0):
+                continue
+            for kind, events, _up, _st in sub.pop_ready():
+                if kind == "snapshot":
+                    state = {}
+                for ev in events:
+                    key = tuple(ev[:-2])
+                    state[key] = state.get(key, 0) + ev[-1]
+        for kind, events, _up, _st in sub.pop_ready():
+            if kind == "snapshot":
+                state = {}
+            for ev in events:
+                key = tuple(ev[:-2])
+                state[key] = state.get(key, 0) + ev[-1]
+        sub_got = {k: n for k, n in state.items() if n}
+        rep.subscribe = sub_got
+        if sub_got != expect:
+            rep.failures.append(
+                "subscription diverged from oracle (double-delivered "
+                f"or lost deltas): {sub_got} != {expect}"
+            )
+        rep.route_changes = sum(
+            t.get("route_changes", 0)
+            for t in coord.subscribe_hub.snapshot()["tails"]
+        )
+        # Surviving connected replicas must end hydrated on `sums`.
+        connected = {
+            r
+            for r, rc in ctl.replicas.items()
+            if rc.connected.is_set()
+        }
+        for df, r, status, _s, _a, error in ctl.hydration_snapshot():
+            if df == "sums" and r in connected and status != "hydrated":
+                rep.failures.append(
+                    f"surviving replica {r} ended {status!r} on sums "
+                    f"(error={error!r})"
+                )
+    except Exception as e:
+        # The report IS the result: a storm that dies mid-flight must
+        # still come back with its failure picture, never a raise.
+        if not rep.failures:
+            rep.failures.append(f"storm aborted: {e!r}")
+    finally:
+        reader_stop.set()
+        if sub is not None:
+            try:
+                sub.close()
+            except Exception:
+                pass
+        try:
+            coord.shutdown()
+        except Exception:
+            pass
+        for rec in records.values():
+            try:
+                if rec["proc"] is not None:
+                    rec["proc"].stop()
+                elif rec["worker"] is not None:
+                    rec["worker"].stop()
+            except Exception:
+                pass
+        rep.elapsed_s = _time.monotonic() - t0
+    return rep
+
+
+def run_failover_smoke(data_dir: str, seed: int = 0) -> FailoverStormReport:
+    """The bounded CI shape (check_plans --bench failover-smoke): two
+    in-process replicas serve a live window, one dies mid-peek, zero
+    client-visible errors and exact rows via failover."""
+    return run_failover_storm(
+        data_dir,
+        seed=seed,
+        ticks=10,
+        replicas=2,
+        subprocess_replicas=False,
+        verify_timeout=120.0,
+    )
+
+
 def run_chaos(
     data_dir: str,
     seed: int = 0,

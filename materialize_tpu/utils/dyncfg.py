@@ -388,3 +388,26 @@ TRANSIENT_PEEK_CACHE = Config(
     "uniquely-named copy; LRU-capped at this many installs, 0 "
     "disables (PR 1's fingerprint stability exists for exactly this)",
 ).register(COMPUTE_CONFIGS)
+
+PEEK_ROUTING = Config(
+    "peek_routing", "route",
+    "read-plane dispatch mode (ISSUE 19): 'route' sends each peek / "
+    "batched lookup to the single least-lagged hydrated replica "
+    "(duplicate dispatches avoided are counted in "
+    "mz_peek_broadcast_avoided_total) and fails over to the next "
+    "candidate on disconnect/stall via retry_policy_failover; "
+    "'broadcast' restores the legacy fan-out-to-all/first-response-"
+    "wins path",
+).register(COMPUTE_CONFIGS)
+
+AUTOSCALE_POLICY = Config(
+    "autoscale_policy", "",
+    "SLO-driven replica autoscaler spec (coord/autoscaler.py), e.g. "
+    "'min=1,max=3,up_sustain=2s,down_sustain=10s,cooldown=5s,"
+    "headroom=0.25,interval=250ms': sustained mz_freshness_events "
+    "breaches spawn a replica (up to max), sustained lag headroom "
+    "(every durable dataflow's latest lag under headroom*slo) drains "
+    "the most-lagged one (down to min), with cooldown hysteresis; "
+    "every decision lands in the mz_autoscale_events ledger. Empty "
+    "disables (production default: opt in per deployment)",
+).register(COMPUTE_CONFIGS)

@@ -243,6 +243,18 @@ RETRY_SHUTDOWN = Config(
     "escalates terminate -> kill",
 ).register(COMPUTE_CONFIGS)
 
+RETRY_FAILOVER = Config(
+    "retry_policy_failover",
+    "base=1s,max=1s,mult=1,jitter=0,attempts=3,budget=10s",
+    "routed-read failover (ISSUE 19): `base` is the per-target stall "
+    "budget before an unanswered routed peek re-dispatches to the "
+    "next least-lagged candidate (disconnects re-dispatch "
+    "immediately, not on this timer); `attempts` caps how many "
+    "routed targets are tried before the terminal one-shot broadcast "
+    "fallback; `budget` bounds drain_replica's wait for in-flight "
+    "reads to move off a draining replica",
+).register(COMPUTE_CONFIGS)
+
 _SURFACES = {
     "reconnect": RETRY_RECONNECT,
     "durability": RETRY_DURABILITY,
@@ -251,6 +263,7 @@ _SURFACES = {
     "frontier_wait": RETRY_FRONTIER_WAIT,
     "peek": RETRY_PEEK,
     "shutdown": RETRY_SHUTDOWN,
+    "failover": RETRY_FAILOVER,
 }
 
 _PARSE_CACHE: dict[str, RetryPolicy] = {}

@@ -305,6 +305,7 @@ def run_bench_mode(verbose: bool) -> int:
     rc |= run_sharding_gates(gate, budgets)
     rc |= run_lockcheck_smoke(gate)
     rc |= run_chaos_smoke(gate)
+    rc |= run_failover_smoke_gate(gate)
     rc |= run_subscribe_smoke(gate, budgets)
     rc |= run_trace_overhead_gate(gate)
     rc |= run_mz_relations_gate(gate)
@@ -716,12 +717,18 @@ def run_mz_relations_gate(gate) -> int:
             "mz_hydration_statuses",
             "mz_source_statuses",
             "mz_sink_statuses",
+            # Elastic-serving plane (ISSUE 19): replica lifecycle and
+            # the autoscaler's decision ledger are operator-facing
+            # surfaces — dropping either breaks the scale-out
+            # dashboards the same way a freshness relation would.
+            "mz_cluster_replicas",
+            "mz_autoscale_events",
         }
         for rel in sorted(required - set(INTROSPECTION_SCHEMAS)):
             findings.append(
                 LintFinding(
                     "mz-relations", rel,
-                    "required freshness-plane relation is not "
+                    "required introspection relation is not "
                     "registered in INTROSPECTION_SCHEMAS",
                 )
             )
@@ -947,6 +954,61 @@ def run_chaos_smoke(gate) -> int:
     finally:
         shutil.rmtree(storm_dir, ignore_errors=True)
     gate("chaos-smoke", None, findings, 0)
+    return 1 if findings else 0
+
+
+def run_failover_smoke_gate(gate) -> int:
+    """Elastic-serving smoke gate (ISSUE 19 satellite): one bounded
+    seeded failover storm — two in-process replicas, routed reads, a
+    pinned in-flight peek, SIGKILL-equivalent stop of the routed-to
+    replica mid-span — asserting exact oracle results, at least one
+    observed failover, and that the post-storm routing target is a
+    survivor. The N=3 subprocess storm stays in `pytest -m "chaos and
+    slow"`; this is the always-on slice of the same machinery."""
+    import shutil
+    import tempfile
+
+    from materialize_tpu.analysis import LintFinding
+    from materialize_tpu.testing.chaos import run_failover_smoke
+
+    storm_dir = tempfile.mkdtemp(prefix="failover-gate-")
+    try:
+        rep = run_failover_smoke(storm_dir, seed=3)
+        findings = [
+            LintFinding("failover-smoke", "invariant", f)
+            for f in rep.failures
+        ]
+        if not rep.failures:
+            if rep.kills != 1:
+                findings.append(
+                    LintFinding(
+                        "failover-smoke", "invariant",
+                        f"expected exactly one mid-peek kill, saw "
+                        f"{rep.kills} — the storm no longer exercises "
+                        "the failover path it exists to gate",
+                    )
+                )
+            if rep.failovers < 1:
+                findings.append(
+                    LintFinding(
+                        "failover-smoke", "invariant",
+                        "routed-to replica was killed mid-peek but "
+                        "the controller recorded zero failovers",
+                    )
+                )
+    except OSError as e:
+        print(f"failover-smoke: skipped (environment: {e!r})")
+        return 0
+    except Exception as e:
+        findings = [
+            LintFinding(
+                "failover-smoke", "driver",
+                f"failover smoke failed to run: {e!r}",
+            )
+        ]
+    finally:
+        shutil.rmtree(storm_dir, ignore_errors=True)
+    gate("failover-smoke", None, findings, 0)
     return 1 if findings else 0
 
 

@@ -425,6 +425,53 @@ class TestReplicaKillStorm:
         assert v["rebuilds"] == 0
 
 
+@pytest.mark.chaos
+class TestFailoverStorm:
+    """Elastic-serving chaos (ISSUE 19): reads are ROUTED to one
+    replica; killing that replica while a peek is parked in flight
+    against it must resolve the peek through failover with exact
+    rows, zero client-visible errors (≤1 retried statement), and a
+    surviving routing target."""
+
+    @pytest.mark.slow
+    def test_smoke_two_replicas_in_process(self, tmp_path):
+        # The failover-smoke CI gate (scripts/check_plans.py --bench)
+        # runs this same storm in the tier-1 window; keep the pytest
+        # copy in the slow/chaos lane.
+        from materialize_tpu.testing.chaos import run_failover_smoke
+
+        rep = run_failover_smoke(str(tmp_path / "fo"), seed=1)
+        assert rep.ok, rep.failures
+        assert rep.kills == 1
+        assert rep.routed_before in rep.killed
+        assert rep.routed_after not in rep.killed
+        # The disconnect re-dispatched the in-flight peek — counted,
+        # not inferred.
+        assert rep.failovers >= 1
+        assert rep.retried_statements <= 1
+        assert rep.reader_queries >= 1
+
+    @pytest.mark.slow
+    def test_sigkill_routed_replica_mid_peek_n3(self, tmp_path):
+        if not subprocess_available():
+            pytest.skip("subprocess spawning unavailable")
+        from materialize_tpu.testing.chaos import run_failover_storm
+
+        rep = run_failover_storm(
+            str(tmp_path / "fo3"), seed=7, ticks=16, replicas=3,
+            subprocess_replicas=True, verify_timeout=480.0,
+        )
+        assert rep.ok, rep.failures
+        assert rep.replicas == 3 and rep.kills == 1
+        assert rep.routed_before in rep.killed
+        assert rep.routed_after not in rep.killed
+        assert rep.failovers >= 1
+        assert rep.retried_statements <= 1
+        # Push-plane attribution followed the failover: the SUBSCRIBE
+        # tail's routed replica changed when the target died.
+        assert rep.route_changes >= 1
+
+
 def _http_sql(port: int, sql: str):
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/api/sql",

@@ -12,9 +12,11 @@ import pytest
 from materialize_tpu.analysis.interleave import (
     MODELS,
     BatcherModel,
+    DrainModel,
     FencingModel,
     HubModel,
     ReconcileModel,
+    ScaleBandModel,
     SetCrashModel,
     WedgeModel,
     explore,
@@ -109,6 +111,85 @@ class TestReconcileAndBatcherAndHub:
         res = explore(lambda: HubModel(locked=False), crash=False)
         assert not res.ok
         assert any("drop" in v.message for v in res.violations)
+
+
+class TestDrainVsInflightPeek:
+    """ISSUE 19 satellite: a replica drain racing an in-flight routed
+    peek — the failover re-dispatch plus the drained replica's
+    straggler answer must settle on EXACTLY one resolution."""
+
+    def test_deduped_failover_resolves_exactly_once(self):
+        res = explore(lambda: DrainModel(dedup=True), crash=False)
+        assert res.ok, "\n".join(v.format() for v in res.violations)
+        assert res.schedules > 1  # the race orders genuinely vary
+
+    def test_unlocked_check_double_resolves(self):
+        """check-resolved outside the lock, resolve inside: both the
+        straggler and the failover target pass the check — the
+        explorer must find the double-resolve the controller's atomic
+        first-response-wins prevents."""
+        res = explore(lambda: DrainModel(dedup=False), crash=False)
+        assert not res.ok
+        assert any(
+            "exactly-once" in v.message for v in res.violations
+        )
+
+
+class TestAutoscaleVsRollingRestart:
+    """ISSUE 19 satellite: autoscaler decisions racing a rolling
+    restart — replica count stays inside the [min,max] band and at
+    least one replica serves at EVERY instant, in both lock
+    acquisition orders (a blocked acquire is not an enabled op, so
+    each order is explored explicitly)."""
+
+    @pytest.mark.parametrize("action", ["spawn", "drain"])
+    @pytest.mark.parametrize("first", ["restarter", "autoscaler"])
+    def test_scale_lock_serializes(self, action, first):
+        res = explore(
+            lambda: ScaleBandModel(
+                locked=True, action=action, first=first
+            ),
+            crash=False,
+        )
+        assert res.ok, "\n".join(v.format() for v in res.violations)
+
+    def test_unlocked_spawn_overflows_the_band(self):
+        """The autoscaler's count read goes stale across the restart's
+        stop/respawn window: spawn lands on top of the respawned
+        replica and the count exceeds max_replicas."""
+        res = explore(
+            lambda: ScaleBandModel(locked=False, action="spawn"),
+            crash=False,
+        )
+        assert not res.ok
+        assert any("band violated" in v.message for v in res.violations)
+
+    def test_unlocked_drain_hits_zero_serving(self):
+        """The drain lands while the restarted replica is down: a
+        window with ZERO serving replicas — the instant-by-instant
+        invariant the environment scale lock (plus the restart's
+        abort-if-no-other-serving precondition) closes."""
+        res = explore(
+            lambda: ScaleBandModel(locked=False, action="drain"),
+            crash=False,
+        )
+        assert not res.ok
+        assert any(
+            "zero serving" in v.message.lower()
+            for v in res.violations
+        )
+
+    def test_locked_drain_first_aborts_restart_not_serving(self):
+        """Autoscaler drains first under the lock: the restart's
+        checked precondition must ABORT (no other serving replica)
+        rather than stop the last one."""
+        res = explore(
+            lambda: ScaleBandModel(
+                locked=True, action="drain", first="autoscaler"
+            ),
+            crash=False,
+        )
+        assert res.ok, "\n".join(v.format() for v in res.violations)
 
 
 class TestChaosBridge:
