@@ -54,6 +54,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from ..utils import lockcheck
 
 
@@ -1126,6 +1128,253 @@ class ScaleBandModel:
             assert "r0" in self.replicas, "restart never respawned r0"
 
 
+class CompactorLeaseSwapModel:
+    """ISSUE 20's compaction lease protocol, explored exhaustively
+    over the REAL persist Machine (MemBlob + MemConsensus, virtual
+    lease clock): a writer appending mid-compaction, compactor A
+    running acquire → merge → renew → fenced swap → delete/release, a
+    rival compactor B trying to take the lease, a reader snapshotting
+    the newest tick, and a clock step that expires every live lease.
+    Crash branches land at the lease-renew and part-swap durable
+    writes (the two writes whose residue — held lease + orphan merged
+    part — a successor must tolerate).
+
+    Invariants at every terminal AND crash state: the reader saw the
+    exact per-tick oracle multiset; the durable shard equals the
+    oracle at upper-1; every state-referenced part key is present in
+    blob (a swap can never publish a batch whose parts a racing
+    delete removed); after a crash the recovery compactor (virtual
+    time far past expiry) always takes over the lease.
+    """
+
+    name = "compactor-lease-swap"
+    daemons = ()
+
+    def __init__(
+        self, lease_s: float = 10.0, delete_before_swap: bool = False
+    ):
+        from ..repr.schema import Column, ColumnType, Schema
+        from ..storage.persist import MemBlob, MemConsensus, PersistClient
+
+        self.lease_s = lease_s
+        # The tempting wrong order — delete the replaced parts BEFORE
+        # the swap CaS. Its window: an append lands between merge and
+        # swap, the swap loses the prefix race, and the state still
+        # references the deleted parts. The explorer must find it
+        # (tests/test_interleave.py pins the violation).
+        self.delete_before_swap = delete_before_swap
+        self.client = PersistClient(MemBlob(), MemConsensus())
+        self.writer = self.client.open_writer(
+            "il",
+            Schema(
+                [
+                    Column("k", ColumnType.INT64),
+                    Column("v", ColumnType.INT64),
+                ]
+            ),
+        )
+        self.machine = self.writer.machine
+        self.reader = self.client.open_reader("il", "model-reader")
+        self.now = 0.0          # virtual lease clock (injected `now`)
+        self.oracle: dict = {}
+        self.oracle_at: dict = {}
+        self.fenced = 0
+        self.swapped = 0
+        self.lost = 0
+        self.rival_lease = None
+        self.bad = None
+        self.recovered = False
+        for t in (0, 1):
+            self._append(t)
+
+    def _append(self, t: int) -> None:
+        upd = [(t % 3, t, 1), (7, 7, 1)]
+        ks = np.array([u[0] for u in upd], np.int64)
+        vs = np.array([u[1] for u in upd], np.int64)
+        self.writer.compare_and_append(
+            [ks, vs],
+            [None, None],
+            np.full(len(upd), t, np.uint64),
+            np.ones(len(upd), np.int64),
+            t,
+            t + 1,
+        )
+        for k, v, d in upd:
+            self.oracle[(k, v)] = self.oracle.get((k, v), 0) + d
+        self.oracle_at[t] = dict(self.oracle)
+
+    @staticmethod
+    def _ms(cols, diff) -> dict:
+        ms: dict = {}
+        for i in range(len(diff)):
+            key = (int(cols[0][i]), int(cols[1][i]))
+            c = ms.get(key, 0) + int(diff[i])
+            if c:
+                ms[key] = c
+            else:
+                ms.pop(key, None)
+        return ms
+
+    def tasks(self):
+        return [
+            ("writer", self._writer()),
+            ("cmp-a", self._compactor()),
+            ("cmp-b", self._rival()),
+            ("reader", self._reader()),
+            ("clock", self._clock()),
+        ]
+
+    def _writer(self):
+        # An append racing the compactor's merge→swap window: the
+        # swap's exact-prefix check makes it lose cleanly (lost += 1),
+        # never drop the append.
+        yield Op("persist.shard", "write", "writer:append(t=2)")
+        self._append(2)
+
+    def _compactor(self):
+        from ..storage.persist.machine import CompactorFenced
+
+        m = self.machine
+        yield Op("persist.shard", "write", "cmp-a:acquire+merge")
+        lease = m.acquire_compaction_lease(
+            "cmp-a", self.lease_s, now=self.now
+        )
+        if lease is None:
+            return  # rival holds a live lease: back off
+        st = m.reload()
+        if len(st.batches) < 2:
+            m.release_compaction_lease(lease)
+            return
+        prefix = st.batches
+        merged_key, n, old_keys = m._merge_parts(st, ctx="background")
+        out_bytes = m._last_merge_bytes[1]
+        yield Op(
+            "persist.shard", "write", "cmp-a:renew-lease",
+            crash_point=True,
+        )
+        if not m.renew_compaction_lease(lease, self.lease_s, now=self.now):
+            self.fenced += 1
+            m._delete_parts([merged_key] if n else [])
+            return
+        yield Op(
+            "persist.shard", "write", "cmp-a:swap-compacted",
+            crash_point=True,
+        )
+        if self.delete_before_swap:
+            m._delete_parts(list(old_keys))
+        try:
+            replaced = m.swap_compacted(
+                prefix, merged_key, n, out_bytes, epoch=lease
+            )
+        except CompactorFenced:
+            self.fenced += 1
+            m._delete_parts([merged_key] if n else [])
+            return
+        yield Op("persist.shard", "write", "cmp-a:delete+release")
+        if replaced:
+            self.swapped += 1
+            m._delete_parts(old_keys)
+        else:
+            self.lost += 1
+            m._delete_parts([merged_key] if n else [])
+        m.release_compaction_lease(lease)
+
+    def _rival(self):
+        # A second compactor claiming the lease and then going silent
+        # (SIGKILL analog): when it lands before cmp-a's renew/swap,
+        # the epoch bump must fence cmp-a's merge out.
+        yield Op("persist.shard", "write", "cmp-b:acquire")
+        self.rival_lease = self.machine.acquire_compaction_lease(
+            "cmp-b", self.lease_s, now=self.now
+        )
+
+    def _clock(self):
+        # Virtual time jumps past every lease deadline: acquires after
+        # this step treat any held lease as expired (takeover path).
+        yield Op("persist.shard", "write", "clock:expire-leases")
+        self.now += self.lease_s + 1.0
+
+    def _reader(self):
+        yield Op("persist.shard", "read", "reader:snapshot")
+        st = self.machine.reload()
+        as_of = st.upper - 1
+        try:
+            _, cols, _, _, diff = self.reader.snapshot(as_of)
+        except ValueError as e:
+            # CompactionRace that never heals = the state references
+            # parts someone deleted; surface it via the invariant.
+            self.bad = f"reader snapshot({as_of}) failed: {e}"
+            return
+        got = self._ms(cols, diff)
+        if got != self.oracle_at[as_of]:
+            self.bad = (
+                f"reader snapshot({as_of}) = {got} != oracle "
+                f"{self.oracle_at[as_of]}"
+            )
+
+    def on_crash(self) -> None:
+        # Recovery: a successor compactor far past every lease expiry
+        # must be able to take over whatever residue the crash left
+        # (held lease, orphan merged part) and compact the shard.
+        m = self.machine
+        self.now += 1000.0
+        lease = m.acquire_compaction_lease(
+            "recovery", self.lease_s, now=self.now
+        )
+        assert lease is not None, (
+            "recovery compactor could not acquire the lease after "
+            "expiry — takeover is wedged"
+        )
+        st = m.reload()
+        try:
+            if len(st.batches) >= 2:
+                prefix = st.batches
+                merged_key, n, old_keys = m._merge_parts(
+                    st, ctx="background"
+                )
+                if m.renew_compaction_lease(
+                    lease, self.lease_s, now=self.now
+                ):
+                    replaced = m.swap_compacted(
+                        prefix, merged_key, n,
+                        m._last_merge_bytes[1], epoch=lease,
+                    )
+                    m._delete_parts(
+                        old_keys if replaced
+                        else ([merged_key] if n else [])
+                    )
+        except AssertionError:
+            # A referenced part is already gone (the planted
+            # delete-before-swap bug): leave the spine for the
+            # invariant's dangling-reference check to report.
+            pass
+        m.release_compaction_lease(lease)
+        self.recovered = True
+
+    def invariant(self, crashed: bool = False) -> None:
+        assert self.bad is None, self.bad
+        st = self.machine.reload()
+        # A published batch's parts must exist: swap-then-delete
+        # ordering, and a fenced merge's cleanup can only delete its
+        # own orphan.
+        for k in sorted(st.referenced_keys()):
+            assert self.machine.blob.get(k) is not None, (
+                f"state references missing blob part {k!r}"
+            )
+        as_of = st.upper - 1
+        _, cols, _, _, diff = self.client.open_reader("il").snapshot(
+            as_of
+        )
+        got = self._ms(cols, diff)
+        assert got == self.oracle_at[as_of], (
+            f"durable shard at {as_of} = {got} != oracle "
+            f"{self.oracle_at[as_of]} (swapped={self.swapped} "
+            f"lost={self.lost} fenced={self.fenced})"
+        )
+        if crashed:
+            assert self.recovered, "on_crash recovery did not run"
+
+
 #: Named model factories for the CLI gate / chaos bridge. Values are
 #: callables(**kwargs) -> fresh model.
 MODELS = {
@@ -1137,6 +1386,7 @@ MODELS = {
     "subscribe-drop": HubModel,
     "replica-drain-peek": DrainModel,
     "autoscale-band": ScaleBandModel,
+    "compactor-lease-swap": CompactorLeaseSwapModel,
 }
 
 

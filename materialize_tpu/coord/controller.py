@@ -661,6 +661,11 @@ class ComputeController:
         # TRACER / LEDGER (pid-deduped), not controller state.
         self.arrangement_bytes: dict[str, dict[str, dict]] = {}
         self.replica_metrics: dict[str, list] = {}
+        # Compaction-plane piggybacks (ISSUE 20, shard -> replica ->
+        # counted stats row): subprocess replicas ship their compactor
+        # activity on Frontiers; merged with the coordinator's own
+        # process-global registry by mz_compactions.
+        self.compactions: dict[str, dict[str, dict]] = {}
         # Async-compile hot-swap states (ISSUE 16, df -> replica ->
         # {"state": pending|swapped|swap-failed, timestamps}): the
         # EXPLAIN ANALYSIS `pending_swap` / mz_program_bank surface.
@@ -1360,6 +1365,12 @@ class ComputeController:
                             ] = v
                         for df, v in msg.get("swaps", {}).items():
                             self.swap_states.setdefault(df, {})[
+                                replica
+                            ] = v
+                        for sh, v in msg.get(
+                            "compactions", {}
+                        ).items():
+                            self.compactions.setdefault(sh, {})[
                                 replica
                             ] = v
                         if "metrics" in msg:

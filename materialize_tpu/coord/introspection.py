@@ -51,6 +51,36 @@ INTROSPECTION_SCHEMAS: dict[str, Schema] = {
             Column("slots_bytes", I),
             Column("lanes_bytes", I),
             Column("history_bytes", I),
+            # Batch-part tiering (ISSUE 20): encoded bytes of this
+            # dataflow's shard parts host-resident in the hot tier vs
+            # blob-only cold — the accounting that drives the
+            # part_hot_bytes budget boundary.
+            Column("hot_bytes", I),
+            Column("cold_bytes", I),
+        ]
+    ),
+    "mz_compactions": Schema(
+        [
+            # Counted compaction-plane activity per shard (ISSUE 20):
+            # which lease epoch last compacted it, merge counts by
+            # context (background service vs writer-inline), the
+            # bytes in/out of merges, and the seconds of maintenance
+            # spent OFF the serving path. The compactor-smoke gate
+            # and the acceptance criterion read these counters.
+            Column("shard", S),
+            Column("replica", S),
+            Column("lease_epoch", I),
+            Column("requests", I),
+            Column("merges_background", I),
+            Column("merges_inline", I),
+            Column("merges_lost", I),
+            Column("blob_writes_background", I),
+            Column("blob_writes_inline", I),
+            Column("input_bytes", I),
+            Column("output_bytes", I),
+            Column("off_path_ms", I),
+            Column("fenced", I),
+            Column("crashes", I),
         ]
     ),
     "mz_span_epochs": Schema(
@@ -333,9 +363,49 @@ def snapshot(coord, name: str) -> list[tuple]:
                     for k in ("runs", "slots", "lanes", "history")
                 ]
                 rows.append(
-                    (_enc(df), _enc(rep), n, sum(comp), *comp)
+                    (
+                        _enc(df), _enc(rep), n, sum(comp), *comp,
+                        int(b.get("part_hot", 0)),
+                        int(b.get("part_cold", 0)),
+                    )
                 )
         return rows
+    if name == "mz_compactions":
+        # Coordinator + in-process replicas share the process-global
+        # registry; subprocess replicas' rows arrive via the Frontiers
+        # piggyback (controller.compactions). Replica "" = this
+        # process.
+        from ..storage.persist.compactor import STATS as _CSTATS
+
+        with coord.controller._lock:
+            shipped = {
+                sh: dict(per)
+                for sh, per in coord.controller.compactions.items()
+            }
+        merged: list = []
+        for sh, s in sorted(_CSTATS.rows().items()):
+            merged.append((sh, "", s))
+        for sh, per in sorted(shipped.items()):
+            for rep, s in sorted(per.items()):
+                merged.append((sh, rep, s))
+        return [
+            (
+                _enc(sh), _enc(rep),
+                int(s.get("lease_epoch", 0)),
+                int(s.get("requests", 0)),
+                int(s.get("merges_background", 0)),
+                int(s.get("merges_inline", 0)),
+                int(s.get("merges_lost", 0)),
+                int(s.get("blob_writes_background", 0)),
+                int(s.get("blob_writes_inline", 0)),
+                int(s.get("input_bytes", 0)),
+                int(s.get("output_bytes", 0)),
+                int(round(1000.0 * s.get("off_path_s", 0.0))),
+                int(s.get("fenced", 0)),
+                int(s.get("crashes", 0)),
+            )
+            for sh, rep, s in merged
+        ]
     if name == "mz_span_epochs":
         # The pipelined control plane's committed span boundaries
         # (ISSUE 7): per (dataflow, replica), the monotone span-epoch
@@ -558,19 +628,33 @@ def snapshot(coord, name: str) -> list[tuple]:
     if name == "mz_trace_spans":
         from ..utils.trace import TRACER
 
-        return [
-            (
-                int(r.trace_id),
-                int(r.span_id),
-                int(r.parent_id or 0),
-                _enc(r.process),
-                _enc(r.name),
-                _enc(r.level),
+        # Hot read path: the ring holds up to 4096 spans and every
+        # snapshot re-renders all of them, ~15x the cost of listing
+        # the ring. A completed SpanRecord is immutable, so cache the
+        # rendered row on the record — stamped with the dict epoch,
+        # since a rebalance relabels the three string codes.
+        epoch = GLOBAL_DICT.epoch
+        enc = GLOBAL_DICT.encode
+        rows = []
+        append = rows.append
+        for r in TRACER.records():
+            cached = r.__dict__.get("_row")
+            if cached is not None and cached[0] == epoch:
+                append(cached[1])
+                continue
+            row = (
+                r.trace_id,
+                r.span_id,
+                r.parent_id or 0,
+                enc(r.process),
+                enc(r.name),
+                enc(r.level),
                 int(r.start * 1e6),
                 int(r.duration * 1e6),
             )
-            for r in TRACER.records()
-        ]
+            r._row = (epoch, row)
+            append(row)
+        return rows
     if name == "mz_compile_log":
         from ..utils.compile_ledger import LEDGER
 

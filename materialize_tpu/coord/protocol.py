@@ -248,6 +248,7 @@ def frontiers(
     arrangement_bytes: dict | None = None,
     freshness: dict | None = None,
     swaps: dict | None = None,
+    compactions: dict | None = None,
 ) -> dict:
     """Replica -> controller frontier report. ``span_epochs`` carries
     each dataflow's monotone COMMITTED span counter (ISSUE 7: the
@@ -283,7 +284,11 @@ def frontiers(
     async-compile hot-swap transitions (ISSUE 16:
     ``{dataflow: {"state": pending|swapped|swap-failed, ...}}``),
     shipped only on change — the EXPLAIN ANALYSIS ``pending_swap``
-    and mz_program_bank surface."""
+    and mz_program_bank surface. ``compactions`` piggybacks the
+    counted compaction stats of shards this replica's compactor
+    touched (ISSUE 20: ``{shard: stats row}``, dirty-set — subprocess
+    replicas only; in-process ones share the process-global registry)
+    — the mz_compactions surface."""
     msg = {
         "kind": "Frontiers",
         "uppers": uppers,
@@ -309,4 +314,6 @@ def frontiers(
         msg["freshness"] = freshness
     if swaps:
         msg["swaps"] = swaps
+    if compactions:
+        msg["compactions"] = compactions
     return msg

@@ -31,7 +31,7 @@ from ..storage.persist import (
     PersistClient,
     SqliteConsensus,
 )
-from ..storage.persist.machine import Fenced
+from ..storage.persist.machine import CompactionRace, Fenced
 from ..storage.persist.operators import SinkConflict
 from . import protocol as ctp
 from .protocol import DataflowDescription, PersistLocation
@@ -91,6 +91,10 @@ class ReplicaWorker:
             self.client = PersistClient(
                 FileBlob(location.blob_root),
                 SqliteConsensus(location.consensus_path),
+                # Production client: sink-shard appends request
+                # background compaction per the compaction_mode dyncfg
+                # (ISSUE 20) instead of growing the spine forever.
+                auto_compaction=True,
             )
         self.replica_id = replica_id
         # Workers per replica = devices in the SPMD mesh
@@ -411,7 +415,9 @@ class ReplicaWorker:
     ) -> _Installed:
         """Build (or rebuild) a dataflow. Hydration can race with an
         active-active sibling writing the same sink (SinkConflict) or
-        with its compaction moving the as_of (ValueError): both are
+        with a concurrent compaction moving the as_of or swapping a
+        part mid-read (CompactionRace — and ONLY that; a blanket
+        ValueError catch used to retry real codec bugs forever): all
         transient — retry against the fresh durable state on the
         unified ``retry_policy_hydration`` backoff. Every attempt is
         visible in the hydration status machine: hydrating (with the
@@ -465,7 +471,7 @@ class ReplicaWorker:
                     desc.name, "hydrated", attempts=attempts
                 )
                 return inst
-            except (SinkConflict, Fenced, ValueError) as e:
+            except (SinkConflict, Fenced, CompactionRace) as e:
                 # Fenced: an active-active sibling re-registered the sink
                 # writer mid-hydration (epoch ping-pong) — rebuild picks
                 # up the durable state it wrote.
@@ -766,18 +772,32 @@ class ReplicaWorker:
         elif kind == "AllowCompaction":
             from ..utils.dyncfg import (
                 ARRANGEMENT_COMPACTION_BATCHES,
+                COMPACTION_MODE,
                 COMPUTE_CONFIGS,
             )
 
             inst = self.dataflows.get(cmd["dataflow"])
             if inst is not None:
+                mode = COMPACTION_MODE(COMPUTE_CONFIGS)
                 for s in inst.view.sources.values():
                     s.reader.downgrade_since(cmd["since"])
-                    s.reader.machine.maybe_compact(
-                        max_batches=ARRANGEMENT_COMPACTION_BATCHES(
-                            COMPUTE_CONFIGS
+                    if mode == "off":
+                        continue
+                    if mode == "inline":
+                        # Pre-ISSUE-20 behavior: merge on the worker
+                        # loop (blocks command drain + span stepping).
+                        s.reader.machine.maybe_compact(
+                            max_batches=ARRANGEMENT_COMPACTION_BATCHES(
+                                COMPUTE_CONFIGS
+                            ),
+                            ctx="inline",
                         )
-                    )
+                    else:
+                        from ..storage.persist.compactor import (
+                            compaction_service,
+                        )
+
+                        compaction_service().request(s.reader.machine)
         elif kind == "UpdateConfiguration":
             # Command-stream ordering makes every worker flip the flags
             # at the same point (compute_state.rs:46-59 analog). The
@@ -1277,8 +1297,18 @@ class ReplicaWorker:
                 for name in dirty
                 if name in self._pending_swap
             }
+        # Compaction stats (ISSUE 20) ride the same way: dirty-set of
+        # shards whose counters moved since the last report. Subprocess
+        # replicas only — in-process ones share the process-global
+        # registry the coordinator serves directly.
+        compactions = {}
+        if self._ship_observability:
+            from ..storage.persist.compactor import STATS as _CSTATS
+
+            compactions = _CSTATS.take_dirty()
         if (changed or donation or sharding or recovery or spans
-                or compiles or metrics or freshness or swaps):
+                or compiles or metrics or freshness or swaps
+                or compactions):
             ctp.send_msg(
                 conn,
                 ctp.frontiers(
@@ -1287,6 +1317,7 @@ class ReplicaWorker:
                     recovery=recovery, spans=spans, compiles=compiles,
                     metrics=metrics, arrangement_bytes=abytes,
                     freshness=freshness, swaps=swaps,
+                    compactions=compactions,
                 ),
             )
             return True

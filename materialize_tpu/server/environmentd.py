@@ -104,6 +104,10 @@ class Environment:
             PersistClient(
                 FileBlob(os.path.join(data_dir, "blob")),
                 SqliteConsensus(os.path.join(data_dir, "consensus.db")),
+                # Production client (ISSUE 20): table/catalog appends
+                # request leased background compaction off the serving
+                # path per the compaction_mode dyncfg.
+                auto_compaction=True,
             ),
             tick_interval=tick_interval,
         )
@@ -440,6 +444,12 @@ class Environment:
         from ..compile.bank import configure_bank
 
         configure_bank(None)
+        # Stop the process-global background compactor for the same
+        # reason: its queue holds Machines rooted in THIS deployment's
+        # blob/consensus; a later Environment starts a fresh one.
+        from ..storage.persist import reset_compaction_service
+
+        reset_compaction_service()
         self.pg.stop()
         self.http.stop()
         self.coord.shutdown()

@@ -6,8 +6,14 @@ machine advanced via Consensus compare-and-set. See location.py, codec.py,
 state.py, machine.py, client.py, operators.py.
 """
 
-from .client import PersistClient, ReadHandle, WriteHandle
+from .client import PartCache, PersistClient, ReadHandle, WriteHandle
 from .codec import decode_part, encode_part, part_stats
+from .compactor import (
+    STATS as COMPACTION_STATS,
+    CompactionService,
+    compaction_service,
+    reset_compaction_service,
+)
 from .location import (
     Blob,
     Consensus,
@@ -19,17 +25,28 @@ from .location import (
     UnreliableBlob,
     VersionedData,
 )
-from .machine import Fenced, Machine, UpperMismatch
+from .machine import (
+    CompactionRace,
+    CompactorFenced,
+    Fenced,
+    Machine,
+    UpperMismatch,
+)
 from .operators import (IndexSource, MaintainedView, ShardSource,
                         updates_to_batch)
+from .pubsub import PUBSUB, ShardPubSub
 from .state import HollowBatch, ShardState
 
 __all__ = [
-    "PersistClient", "ReadHandle", "WriteHandle",
+    "PartCache", "PersistClient", "ReadHandle", "WriteHandle",
     "decode_part", "encode_part", "part_stats",
+    "COMPACTION_STATS", "CompactionService", "compaction_service",
+    "reset_compaction_service",
     "Blob", "Consensus", "ExternalDurabilityError", "FileBlob", "MemBlob",
     "MemConsensus", "SqliteConsensus", "UnreliableBlob", "VersionedData",
-    "Fenced", "Machine", "UpperMismatch",
+    "CompactionRace", "CompactorFenced", "Fenced", "Machine",
+    "UpperMismatch",
     "IndexSource", "MaintainedView", "ShardSource", "updates_to_batch",
+    "PUBSUB", "ShardPubSub",
     "HollowBatch", "ShardState",
 ]

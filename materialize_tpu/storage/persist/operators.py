@@ -38,7 +38,9 @@ class SinkConflict(RuntimeError):
 class AsOfError(RuntimeError):
     """AS OF timestamp outside the readable multiversion window
     [since, upper). Deliberately NOT a ValueError: the replica's build
-    retry loop treats ValueError as a transient compaction race, and a
+    retry loop retries transient compaction races — machine.py's
+    dedicated ``CompactionRace``, no longer blanket ValueError, so a
+    real codec/caller bug surfaces instead of retrying forever — and a
     bad user timestamp must fail immediately."""
 
 
@@ -629,6 +631,30 @@ class MaintainedView:
         bytes_["history"] = device_nbytes(
             [upd for _t, upd in self._history]
         )
+        # Batch-part tiering split (ISSUE 20): hot (host-resident in
+        # the client's part cache) vs cold (blob-only, rehydrated on
+        # first read) encoded bytes over this view's shards — the
+        # mz_arrangement_sizes hot/cold columns that drive the
+        # part_hot_bytes budget decision. Cached state only; no
+        # consensus read on the frontier-report path.
+        hot = cold = 0
+        # Index imports have a reader SHIM (IndexSource._Reader) with
+        # no shard behind it — only real shard sources tier.
+        shards = {
+            sh
+            for s in self.sources.values()
+            if hasattr(s, "reader")
+            for sh in [getattr(s.reader.machine, "shard", None)]
+            if sh is not None
+        }
+        if self.writer is not None:
+            shards.add(self.writer.machine.shard)
+        for shard in shards:
+            h, c = self.client.tier_split(shard)
+            hot += h
+            cold += c
+        bytes_["part_hot"] = hot
+        bytes_["part_cold"] = cold
         return bytes_
 
     def updates_as_of(self, t: int):

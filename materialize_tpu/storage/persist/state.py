@@ -24,6 +24,10 @@ class HollowBatch:
     upper: int
     keys: tuple[str, ...]
     n_updates: int
+    # Encoded part bytes (sum over keys). Durable so tiering can split
+    # hot/cold bytes without fetching cold parts; 0 on states written
+    # before this field existed.
+    n_bytes: int = 0
 
     def to_json(self):
         return {
@@ -31,11 +35,15 @@ class HollowBatch:
             "upper": self.upper,
             "keys": list(self.keys),
             "n": self.n_updates,
+            "bytes": self.n_bytes,
         }
 
     @staticmethod
     def from_json(d) -> "HollowBatch":
-        return HollowBatch(d["lower"], d["upper"], tuple(d["keys"]), d["n"])
+        return HollowBatch(
+            d["lower"], d["upper"], tuple(d["keys"]), d["n"],
+            d.get("bytes", 0),
+        )
 
 
 @dataclass(frozen=True)
@@ -53,6 +61,16 @@ class ShardState:
     # Opaque per-reader since holds: reader id -> frontier. The shard
     # since is the min of these (read holds, coord/read_policy.rs analog).
     reader_holds: tuple[tuple[str, int], ...] = ()
+    # Compaction lease (internal/compact.rs + the PR 7 epoch fencing
+    # discipline): at most one compactor holds the lease per shard;
+    # the epoch is the fencing token a swap-in must present, so a
+    # compactor that lost its lease (expiry + handoff) cannot swap a
+    # stale merge over batches a successor already replaced.
+    compactor_epoch: int = 0
+    compactor_holder: str = ""
+    # Wall-clock lease deadline (seconds, time.time domain). A crashed
+    # compactor's lease is reclaimable once this passes.
+    lease_expires: float = 0.0
 
     def to_bytes(self) -> bytes:
         return json.dumps(
@@ -64,6 +82,9 @@ class ShardState:
                 "batches": [b.to_json() for b in self.batches],
                 "writer_epoch": self.writer_epoch,
                 "reader_holds": list(map(list, self.reader_holds)),
+                "compactor_epoch": self.compactor_epoch,
+                "compactor_holder": self.compactor_holder,
+                "lease_expires": self.lease_expires,
             }
         ).encode()
 
@@ -80,6 +101,9 @@ class ShardState:
             reader_holds=tuple(
                 (r, f) for r, f in d.get("reader_holds", [])
             ),
+            compactor_epoch=d.get("compactor_epoch", 0),
+            compactor_holder=d.get("compactor_holder", ""),
+            lease_expires=d.get("lease_expires", 0.0),
         )
 
     def referenced_keys(self) -> set[str]:

@@ -1505,6 +1505,374 @@ def run_failover_smoke(data_dir: str, seed: int = 0) -> FailoverStormReport:
     )
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 20: compactor storm — leased background compaction under fire
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CompactorStormReport:
+    """Off-path compaction chaos outcome: a churn workload runs with
+    the production tick path (request-only, ``auto_compaction=True``)
+    while the background compactor is SIGKILLed mid-merge (lease held,
+    orphan part — the crash hook leaves exactly a SIGKILL's durable
+    residue), a second compactor takes over after lease expiry, a
+    stale-epoch swap is fenced, and readers race just-swapped parts.
+    Every read and the final state must equal the host oracle multiset
+    EXACTLY, and every invariant here is a counter, not an inspection:
+    zero tick-path merges/blob-writes, >=1 crash, >=1 handoff, >=1
+    fenced swap, bounded uncompacted spine."""
+
+    ticks: int = 0
+    appends: int = 0
+    requests: int = 0
+    merges_background: int = 0
+    merges_inline: int = 0
+    blob_writes_inline: int = 0
+    blob_writes_background: int = 0
+    crashes: int = 0
+    crash_residue_holder: str = ""
+    handoffs: int = 0
+    handoff_epoch: int = 0
+    fenced_swaps: int = 0
+    reader_reads: int = 0
+    reader_races: int = 0
+    rehydrations: int = 0
+    final_batches: int = -1
+    orphan_parts: int = 0
+    oracle_rows: int = 0
+    failures: list = field(default_factory=list)
+    elapsed_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+def _kv_multiset(cols, diff) -> dict:
+    """(k, v) -> count from snapshot columns; zero counts dropped so
+    dict equality IS multiset equality."""
+    ms: dict = {}
+    if not len(diff):
+        return ms
+    ks, vs = cols[0], cols[1]
+    for i in range(len(diff)):
+        key = (int(ks[i]), int(vs[i]))
+        c = ms.get(key, 0) + int(diff[i])
+        if c:
+            ms[key] = c
+        else:
+            ms.pop(key, None)
+    return ms
+
+
+def run_compactor_storm(
+    data_dir: str,
+    seed: int = 0,
+    ticks: int = 36,
+    blob_fail_every: int = 11,
+    lease_s: float = 0.6,
+) -> CompactorStormReport:
+    """Churn + crash + handoff + race against the leased background
+    compactor (ISSUE 20 chaos coverage). The writer appends with
+    ``auto_compaction=True`` so compaction flows through the real tick
+    path: an O(1) request to the shared background service — the storm
+    asserts BY COUNTER that the tick path never merged and never wrote
+    a compaction blob. Mid-storm:
+
+    1. compactor A is crashed AFTER its merge blob-write but BEFORE
+       the swap (``crash_next='merge'`` — a SIGKILL's residue: lease
+       still held, orphan merged part in blob, state untouched);
+    2. compactor B is fenced out while A's lease is live, then takes
+       over after expiry (counted handoff; epoch bumps);
+    3. a swap presented with a stale lease epoch raises
+       ``CompactorFenced`` (the swap-in rejection, counted);
+    4. a reader holding a pre-swap batch list observes the swapped-out
+       parts as ``CompactionRace`` and the retrying snapshot path
+       heals to the exact oracle multiset — while a free-running
+       reader thread snapshots the newest tick throughout.
+    """
+    from ..storage.persist import (
+        FileBlob,
+        PersistClient,
+        SqliteConsensus,
+        UnreliableBlob,
+    )
+    from ..storage.persist.compactor import (
+        STATS,
+        CompactionService,
+        CompactorCrash,
+        compaction_service,
+        reset_compaction_service,
+    )
+    from ..storage.persist.machine import CompactionRace, CompactorFenced
+    from ..utils.dyncfg import (
+        ARRANGEMENT_COMPACTION_BATCHES,
+        COMPUTE_CONFIGS,
+    )
+
+    os.makedirs(data_dir, exist_ok=True)
+    rng = random.Random(seed)
+    t_start = _time.monotonic()
+    report = CompactorStormReport(ticks=ticks)
+    threshold = ARRANGEMENT_COMPACTION_BATCHES(COMPUTE_CONFIGS)
+
+    blob = FileBlob(os.path.join(data_dir, "blob"))
+    if blob_fail_every:
+        blob = UnreliableBlob(blob, fail_every=blob_fail_every)
+    client = PersistClient(
+        blob,
+        SqliteConsensus(os.path.join(data_dir, "consensus.db")),
+        auto_compaction=True,  # the production tick path: request-only
+    )
+    writer = client.open_writer("kvc", _mk_kv_schema())
+    machine = writer.machine
+    reset_compaction_service()
+    STATS.reset()
+    svc_a = CompactionService(holder="chaos-compactor-a", lease_s=lease_s)
+    svc_b = CompactionService(holder="chaos-compactor-b", lease_s=lease_s)
+
+    oracle: dict = {}
+    live: list = []
+    oracle_at: dict[int, dict] = {}
+    lock = threading.Lock()
+    latest = [-1]
+    clock = [0]
+
+    def append_tick():
+        t = clock[0]
+        rows = [
+            (rng.randrange(8), rng.randrange(100))
+            for _ in range(rng.randrange(3, 7))
+        ]
+        upd = [(k, v, 1) for k, v in rows]
+        for _ in range(min(len(live), rng.randrange(0, 3))):
+            k, v = live.pop(rng.randrange(len(live)))
+            upd.append((k, v, -1))
+        live.extend(rows)
+        ks = np.array([u[0] for u in upd], np.int64)
+        vs = np.array([u[1] for u in upd], np.int64)
+        time = np.full(len(upd), t, np.uint64)
+        diff = np.array([u[2] for u in upd], np.int64)
+        writer.compare_and_append(
+            [ks, vs], [None, None], time, diff, t, t + 1
+        )
+        for k, v, d in upd:
+            c = oracle.get((k, v), 0) + d
+            if c:
+                oracle[(k, v)] = c
+            else:
+                oracle.pop((k, v), None)
+        with lock:
+            oracle_at[t] = dict(oracle)
+            latest[0] = t
+        clock[0] = t + 1
+        report.appends += 1
+
+    # Free-running reader: snapshot the newest closed tick and demand
+    # the exact per-tick oracle multiset while compactors swap parts
+    # underneath (its CompactionRace retries are counted).
+    storm_reader = client.open_reader("kvc", "storm-reader")
+    stop = threading.Event()
+
+    def reader_loop():
+        while not stop.is_set():
+            with lock:
+                t = latest[0]
+                want = oracle_at.get(t)
+            if t < 0:
+                _time.sleep(0.001)
+                continue
+            try:
+                _, cols, _, _, diff = storm_reader.snapshot(t)
+            except CompactionRace:
+                continue  # racing a since downgrade: re-pick the tick
+            got = _kv_multiset(cols, diff)
+            if got != want:
+                report.failures.append(
+                    f"reader snapshot(as_of={t}) != oracle "
+                    f"({len(got)} vs {len(want)} distinct rows)"
+                )
+                stop.set()
+                return
+            report.reader_reads += 1
+            _time.sleep(0.0005)
+
+    rt = threading.Thread(target=reader_loop, daemon=True)
+    rt.start()
+
+    def grow_past_threshold():
+        while len(machine.reload().batches) <= threshold:
+            append_tick()
+
+    try:
+        crash_tick = max(6, ticks // 3)
+        for _ in range(ticks):
+            append_tick()
+            if clock[0] - 1 != crash_tick or report.crashes:
+                continue
+
+            # (1) SIGKILL compactor A after its merge blob-write.
+            svc_a.crash_next = "merge"
+            for _ in range(300):
+                if len(machine.reload().batches) <= threshold:
+                    append_tick()
+                try:
+                    svc_a.compact_shard(machine)
+                except CompactorCrash:
+                    report.crashes += 1
+                    break
+                _time.sleep(0.005)
+            else:
+                report.failures.append("crash injection never fired")
+            st = machine.reload()
+            report.crash_residue_holder = st.compactor_holder
+            if st.compactor_holder != svc_a.holder:
+                report.failures.append(
+                    "crashed compactor's lease not held: "
+                    f"{st.compactor_holder!r}"
+                )
+
+            # (2) B is walled off while A's lease lives, then takes
+            # over once it expires.
+            r = svc_b.compact_shard(machine)
+            if r.get("skipped") != "lease-held" and "replaced" not in r:
+                report.failures.append(
+                    f"unexpected pre-expiry compaction outcome: {r}"
+                )
+            deadline = _time.monotonic() + 20 * lease_s
+            while _time.monotonic() < deadline:
+                if len(machine.reload().batches) <= threshold:
+                    append_tick()
+                try:
+                    r = svc_b.compact_shard(machine)
+                except CompactorCrash:
+                    r = {}
+                if "replaced" in r:
+                    report.handoffs += 1
+                    report.handoff_epoch = int(r["lease_epoch"])
+                    break
+                _time.sleep(lease_s / 20)
+            else:
+                report.failures.append("lease handoff never completed")
+
+            # (3) a swap presenting a stale lease epoch must be
+            # rejected (the swap-in fence).
+            st = machine.reload()
+            if st.batches:
+                try:
+                    machine.swap_compacted(
+                        st.batches, "kvc/stale-probe", 1, 1,
+                        epoch=st.compactor_epoch + 1000,
+                    )
+                    report.failures.append("stale-epoch swap not fenced")
+                except CompactorFenced:
+                    report.fenced_swaps += 1
+
+            # (4) a reader pinned to the pre-swap batch list sees the
+            # swapped-out parts as CompactionRace; its retrying
+            # snapshot still yields the exact oracle.
+            probe = client.open_reader("kvc", "race-probe")
+            grow_past_threshold()
+            stale_batches = list(machine.reload().batches)
+            swapped = False
+            for _ in range(400):
+                # max_batches=0: merge whatever spine exists, so the
+                # swap can't be starved by the shared service racing
+                # us to every over-threshold spine.
+                r = svc_b.compact_shard(machine, max_batches=0)
+                if r.get("replaced"):
+                    swapped = True
+                    break
+                _time.sleep(0.005)
+            if not swapped:
+                report.failures.append("race-probe swap never landed")
+            elif stale_batches:
+                try:
+                    probe._read_parts(stale_batches)
+                    report.failures.append(
+                        "stale batch list readable after swap "
+                        "(parts not deleted?)"
+                    )
+                except CompactionRace:
+                    report.reader_races += 1
+            with lock:
+                t = latest[0]
+                want = dict(oracle_at[t])
+            _, cols, _, _, diff = probe.snapshot(t)
+            if _kv_multiset(cols, diff) != want:
+                report.failures.append(
+                    "post-swap probe snapshot != oracle"
+                )
+            probe.expire()
+
+        # Drain the shared service the tick path enqueued into, then
+        # verify the end state exactly.
+        compaction_service().drain(timeout=20.0)
+        stop.set()
+        rt.join(timeout=10.0)
+
+        final_t = latest[0]
+        verify = client.open_reader("kvc", "verify")
+        _, cols, _, _, diff = verify.snapshot(final_t)
+        got = _kv_multiset(cols, diff)
+        if got != oracle:
+            report.failures.append(
+                f"final snapshot != oracle ({len(got)} vs "
+                f"{len(oracle)} distinct rows)"
+            )
+        report.oracle_rows = sum(oracle.values())
+
+        st = machine.reload()
+        report.final_batches = len(st.batches)
+        bound = 3 * threshold + 2
+        if report.final_batches > bound:
+            report.failures.append(
+                f"uncompacted spine unbounded: {report.final_batches}"
+                f" batches > {bound}"
+            )
+        refd = st.referenced_keys()
+        report.orphan_parts = len(
+            [k for k in blob.list_keys("kvc/") if k not in refd]
+        )
+
+        tot = STATS.totals()
+        report.requests = tot["requests"]
+        report.merges_background = tot["merges_background"]
+        report.merges_inline = tot["merges_inline"]
+        report.blob_writes_inline = tot["blob_writes_inline"]
+        report.blob_writes_background = tot["blob_writes_background"]
+        report.rehydrations = client.part_cache.stats()["rehydrations"]
+        report.reader_races += storm_reader.race_retries
+        if tot["merges_inline"] or tot["blob_writes_inline"]:
+            report.failures.append(
+                "tick path did compaction work under background mode:"
+                f" merges_inline={tot['merges_inline']}"
+                f" blob_writes_inline={tot['blob_writes_inline']}"
+            )
+        if not tot["merges_background"]:
+            report.failures.append("background compactor never merged")
+        if not report.requests:
+            report.failures.append("tick path never requested compaction")
+        report.elapsed_s = _time.monotonic() - t_start
+        return report
+    finally:
+        stop.set()
+        rt.join(timeout=5.0)
+        reset_compaction_service()
+
+
+def run_compactor_smoke(
+    data_dir: str, seed: int = 0
+) -> CompactorStormReport:
+    """The bounded CI shape (check_plans --bench compactor-smoke):
+    fewer ticks, a short lease, UnreliableBlob on — same counted
+    invariants as the full storm."""
+    return run_compactor_storm(
+        data_dir, seed=seed, ticks=18, blob_fail_every=9, lease_s=0.4
+    )
+
+
 def run_chaos(
     data_dir: str,
     seed: int = 0,

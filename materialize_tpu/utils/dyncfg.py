@@ -109,6 +109,41 @@ ARRANGEMENT_COMPACTION_BATCHES = Config(
     "shard spine length that triggers background compaction",
 ).register(COMPUTE_CONFIGS)
 
+COMPACTION_MODE = Config(
+    "compaction_mode", "background",
+    "where shard compaction runs when a writer's append grows the "
+    "spine past arrangement_compaction_batches: 'background' enqueues "
+    "to the leased compactor service (storage/persist/compactor.py; "
+    "the tick path's entire cost is the O(1) request), 'inline' merges "
+    "synchronously on the writer's path (the pre-ISSUE-20 behavior, "
+    "kept as the bench comparison baseline), 'off' never triggers "
+    "(manual maybe_compact only)",
+).register(COMPUTE_CONFIGS)
+
+COMPACTION_LEASE_S = Config(
+    "compaction_lease_s", 5.0,
+    "compaction lease duration: a crashed compactor's shard is "
+    "reclaimable by a successor after this long; the holder renews "
+    "before every swap, and epoch fencing rejects a stale holder that "
+    "outlived its lease",
+).register(COMPUTE_CONFIGS)
+
+PART_TIERING = Config(
+    "part_tiering", "auto",
+    "batch-part hot/cold tiering: 'auto' keeps recently "
+    "written/read decoded parts host-resident up to part_hot_bytes "
+    "(LRU eviction to blob-only cold tier, lazy rehydration on first "
+    "read), 'all_hot' never evicts, 'all_cold' caches nothing (every "
+    "read rehydrates from blob — the worst-case latency baseline)",
+).register(COMPUTE_CONFIGS)
+
+PART_HOT_BYTES = Config(
+    "part_hot_bytes", 64 << 20,
+    "hot-tier budget in encoded part bytes per process (part_tiering="
+    "auto); mz_arrangement_sizes' hot/cold byte split reports the "
+    "resulting boundary per dataflow",
+).register(COMPUTE_CONFIGS)
+
 OPTIMIZER_TYPECHECK = Config(
     "optimizer_typecheck", False,
     "run the MIR typechecker (analysis/typecheck.py) between optimizer "

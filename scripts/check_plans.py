@@ -306,6 +306,7 @@ def run_bench_mode(verbose: bool) -> int:
     rc |= run_lockcheck_smoke(gate)
     rc |= run_chaos_smoke(gate)
     rc |= run_failover_smoke_gate(gate)
+    rc |= run_compactor_smoke_gate(gate)
     rc |= run_subscribe_smoke(gate, budgets)
     rc |= run_trace_overhead_gate(gate)
     rc |= run_mz_relations_gate(gate)
@@ -1009,6 +1010,71 @@ def run_failover_smoke_gate(gate) -> int:
     finally:
         shutil.rmtree(storm_dir, ignore_errors=True)
     gate("failover-smoke", None, findings, 0)
+    return 1 if findings else 0
+
+
+def run_compactor_smoke_gate(gate) -> int:
+    """Off-path compaction smoke gate (ISSUE 20): one bounded churn
+    storm under UnreliableBlob with the production tick path
+    (auto_compaction, compaction_mode=background) plus the full lease
+    choreography — compactor crashed after its merge blob-write,
+    lease-expiry handoff to a second compactor, stale-epoch swap
+    fence, reader racing a just-swapped part. The gate's acceptance
+    invariants are COUNTERS, not inspection: zero tick-path merges
+    and zero tick-path compaction blob writes, >=1 background merge,
+    and a bounded uncompacted-run count — plus exact oracle multisets
+    on every read (rep.failures). The long storm stays in
+    `pytest -m "chaos and slow"`."""
+    import shutil
+    import tempfile
+
+    from materialize_tpu.analysis import LintFinding
+    from materialize_tpu.testing.chaos import run_compactor_smoke
+
+    storm_dir = tempfile.mkdtemp(prefix="compactor-gate-")
+    try:
+        rep = run_compactor_smoke(storm_dir, seed=1)
+        findings = [
+            LintFinding("compactor-smoke", "invariant", f)
+            for f in rep.failures
+        ]
+        if not rep.failures:
+            for check, msg in (
+                (
+                    rep.crashes == 1,
+                    f"expected exactly one injected compactor crash, "
+                    f"saw {rep.crashes}",
+                ),
+                (
+                    rep.handoffs >= 1,
+                    "no lease-expiry handoff to the second compactor",
+                ),
+                (
+                    rep.fenced_swaps >= 1,
+                    "stale-epoch swap was never fenced",
+                ),
+                (
+                    rep.reader_races >= 1,
+                    "no reader ever raced a just-swapped part",
+                ),
+            ):
+                if not check:
+                    findings.append(
+                        LintFinding("compactor-smoke", "invariant", msg)
+                    )
+    except OSError as e:
+        print(f"compactor-smoke: skipped (environment: {e!r})")
+        return 0
+    except Exception as e:
+        findings = [
+            LintFinding(
+                "compactor-smoke", "driver",
+                f"compactor smoke failed to run: {e!r}",
+            )
+        ]
+    finally:
+        shutil.rmtree(storm_dir, ignore_errors=True)
+    gate("compactor-smoke", None, findings, 0)
     return 1 if findings else 0
 
 

@@ -635,3 +635,49 @@ class TestEnvironmentdCrash:
                     proc.wait(timeout=30)
                 except Exception:
                     pass
+
+
+@pytest.mark.chaos
+class TestCompactorStorm:
+    """Leased background compaction under fire (ISSUE 20): the tick
+    path only *requests* compaction; compactor A is crashed after its
+    merge blob-write (lease held, orphan part — a SIGKILL's durable
+    residue), compactor B takes over after lease expiry, a stale-epoch
+    swap is fenced, and a reader pinned to a pre-swap batch list
+    retries through CompactionRace. Every invariant is a counter."""
+
+    def test_compactor_smoke(self, tmp_path):
+        from materialize_tpu.testing.chaos import run_compactor_smoke
+
+        rep = run_compactor_smoke(str(tmp_path / "cs"), seed=1)
+        assert rep.ok, rep.failures
+        # The SIGKILL residue: exactly one injected crash, and the
+        # crashed compactor's lease was still held when we looked.
+        assert rep.crashes == 1
+        assert rep.crash_residue_holder == "chaos-compactor-a"
+        # Expiry + handoff: B landed a merge with a bumped epoch.
+        assert rep.handoffs == 1
+        assert rep.handoff_epoch >= 2
+        # The swap-in fence rejected a stale lease epoch.
+        assert rep.fenced_swaps == 1
+        # A reader racing the just-swapped parts observed the race and
+        # the retrying snapshot healed to the exact oracle (rep.ok).
+        assert rep.reader_races >= 1
+        assert rep.reader_reads >= 1
+        # Zero tick-path compaction work, by counter.
+        assert rep.merges_inline == 0
+        assert rep.blob_writes_inline == 0
+        assert rep.merges_background >= 1
+        assert rep.requests >= 1
+
+    @pytest.mark.slow
+    def test_compactor_storm_long(self, tmp_path):
+        from materialize_tpu.testing.chaos import run_compactor_storm
+
+        rep = run_compactor_storm(
+            str(tmp_path / "cst"), seed=7, ticks=48, blob_fail_every=7
+        )
+        assert rep.ok, rep.failures
+        assert rep.crashes == 1 and rep.handoffs == 1
+        assert rep.merges_inline == 0 and rep.blob_writes_inline == 0
+        assert rep.final_batches >= 0

@@ -192,6 +192,44 @@ class TestAutoscaleVsRollingRestart:
         assert res.ok, "\n".join(v.format() for v in res.violations)
 
 
+class TestCompactorLeaseSwap:
+    """ISSUE 20: the compaction lease protocol over the REAL persist
+    Machine — writer-append vs compactor merge/renew/swap vs rival
+    lease takeover vs reader snapshot, with crash branches at the
+    lease-renew and part-swap durable writes."""
+
+    def test_lease_swap_protocol_is_safe(self):
+        from materialize_tpu.analysis.interleave import (
+            CompactorLeaseSwapModel,
+        )
+
+        res = explore(CompactorLeaseSwapModel)
+        assert not res.truncated
+        assert res.ok, "\n".join(v.format() for v in res.violations)
+        # The space actually contains the interesting orderings: both
+        # crash points were branched.
+        assert res.crash_branches >= 2
+
+    def test_delete_before_swap_is_found(self):
+        """The tempting wrong order — delete replaced parts BEFORE the
+        swap CaS — dangles the state's part references the moment the
+        swap loses a race (a concurrent append, or a rival compactor's
+        epoch fence), and the explorer must find it."""
+        from materialize_tpu.analysis.interleave import (
+            CompactorLeaseSwapModel,
+        )
+
+        res = explore(
+            lambda: CompactorLeaseSwapModel(delete_before_swap=True)
+        )
+        assert not res.ok
+        assert any(
+            "missing blob part" in v.message
+            or "swapped out" in v.message
+            for v in res.violations
+        )
+
+
 class TestChaosBridge:
     def test_trace_round_trips_to_a_fault_plan(self):
         """Satellite 4: a violation trace JSON-round-trips into a

@@ -550,10 +550,14 @@ class TestIntrospectionConcurrency:
             from materialize_tpu.repr.schema import GLOBAL_DICT
 
             # Hammer the raw row constructors (where a torn read or
-            # dict-mutation race would live) for the whole window...
+            # dict-mutation race would live) for the whole window.
+            # Run until BOTH the time window and the iteration floor
+            # are met: with 8 spinning writers on a loaded one-core
+            # box the reader's GIL share is unpredictable, and a
+            # fixed window alone flakes at 9/10 iterations.
             deadline = _time.monotonic() + 3.0
             reads = 0
-            while _time.monotonic() < deadline:
+            while _time.monotonic() < deadline or reads < 10:
                 for vals in snapshot(coord, "mz_metrics"):
                     assert isinstance(vals[-1], float)
                 for vals in snapshot(coord, "mz_trace_spans"):
